@@ -1,0 +1,67 @@
+"""incubator_mxnet_tpu — a TPU-native deep-learning framework with the
+capabilities of Apache MXNet (incubating) v0.11.
+
+Built from scratch on jax/XLA/pallas/pjit: the reference
+(SmartAILM/incubator-mxnet) defines WHAT — the API surface, semantics and
+test contract documented in SURVEY.md — while the architecture here is
+TPU-first: XLA owns kernels/fusion/memory, ``jax.sharding`` + collectives own
+distribution, and the runtime layers (engine, kvstore, io) are thin native
+facades over them.
+
+Usage mirrors the reference::
+
+    import incubator_mxnet_tpu as mx
+    a = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=10)
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, tpu, gpu, cpu_pinned, current_context, \
+    num_tpus, num_gpus
+from . import engine
+from . import random
+from . import autograd
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+
+# Stage-gated imports: these grow as layers land (SURVEY.md §7 ordering).
+_OPTIONAL = [
+    ("symbol", ("sym",)), ("executor", ()), ("initializer", ()),
+    ("optimizer", ()), ("lr_scheduler", ()), ("metric", ()), ("io", ()),
+    ("recordio", ()), ("kvstore", ("kv",)), ("callback", ()),
+    ("monitor", ()), ("module", ("mod",)), ("name", ()), ("attribute", ()),
+    ("registry", ()), ("profiler", ()), ("visualization", ("viz",)),
+    ("test_utils", ()), ("parallel", ()), ("models", ()), ("gluon", ()),
+    ("rnn", ()), ("image", ()),
+]
+
+import importlib as _importlib
+import sys as _sys
+
+for _name, _aliases in _OPTIONAL:
+    try:
+        _m = _importlib.import_module("." + _name, __name__)
+    except ModuleNotFoundError as _e:
+        # only tolerate the module itself not existing yet; real import bugs
+        # inside an existing module must surface
+        if _e.name and _e.name.endswith("." + _name):
+            continue
+        raise
+    globals()[_name] = _m
+    for _a in _aliases:
+        globals()[_a] = _m
+        _sys.modules[__name__ + "." + _a] = _m
+
+if "symbol" in globals():
+    Symbol = symbol.Symbol  # noqa: F821
+if "attribute" in globals():
+    AttrScope = attribute.AttrScope  # noqa: F821
+if "optimizer" in globals():
+    Optimizer = optimizer.Optimizer  # noqa: F821
+
+waitall = nd.waitall

@@ -1,0 +1,257 @@
+"""Imperative autograd.
+
+Reference analog: ``autograd::AutogradRuntime`` (``src/ndarray/autograd.h:42-149``,
+``.cc:174-279``) — thread-local ``is_train``/``is_recording`` flags, a tape of
+``AGNode`` entries hung off output NDArrays, and ``ComputeGradient`` walking
+the tape.  TPU-native redesign: each tape node stores the op + captured input
+values; ``backward`` runs reverse topological order calling ``jax.vjp`` of the
+op's forward per node — no separate Gradient graph pass or fresh executor is
+needed because jax vjp *is* the gradient pass.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad",
+           "set_recording", "set_training"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(flag: bool) -> bool:
+    s = _st()
+    old, s.recording = s.recording, flag
+    return old
+
+
+def set_training(flag: bool) -> bool:
+    s = _st()
+    old, s.training = s.training, flag
+    return old
+
+
+class _RecordingState:
+    """``with autograd.record():`` context (python/mxnet/autograd.py)."""
+
+    def __init__(self, enter_record: Optional[bool], enter_train: Optional[bool]):
+        self._er = enter_record
+        self._et = enter_train
+        self._old_r = None
+        self._old_t = None
+
+    def __enter__(self):
+        if self._er is not None:
+            self._old_r = set_recording(self._er)
+        if self._et is not None:
+            self._old_t = set_training(self._et)
+        return self
+
+    def __exit__(self, *exc):
+        if self._old_r is not None:
+            set_recording(self._old_r)
+        if self._old_t is not None:
+            set_training(self._old_t)
+
+
+def record(train_mode: bool = True) -> _RecordingState:
+    return _RecordingState(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _RecordingState:
+    return _RecordingState(False, train_mode)
+
+
+def train_mode() -> _RecordingState:
+    return _RecordingState(None, True)
+
+
+def predict_mode() -> _RecordingState:
+    return _RecordingState(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+
+class TapeNode:
+    """AGNode analog: one recorded op application."""
+
+    __slots__ = ("op", "attrs", "opctx", "inputs", "input_vals", "n_args",
+                 "out_entries")
+
+    def __init__(self, op, attrs, opctx, inputs, input_vals, n_args):
+        self.op = op
+        self.attrs = attrs
+        self.opctx = opctx
+        self.inputs = inputs          # list of NDArray (strong refs)
+        self.input_vals = input_vals  # jax arrays captured at record time
+        self.n_args = n_args          # inputs beyond this are aux (no grads)
+
+
+def record_op(op, attrs, opctx, input_nds, input_vals, output_nds,
+              n_args: int) -> None:
+    """Called by the nd invoke path while recording
+    (``AutogradRuntime::RecordImperativeFCompute`` analog)."""
+    node = TapeNode(op, dict(attrs), opctx, list(input_nds),
+                    list(input_vals), n_args)
+    for i, o in enumerate(output_nds):
+        o._ag_entry = (node, i)
+
+
+def mark_variables(variables: Sequence[Any], gradients: Sequence[Any],
+                   grad_reqs="write") -> None:
+    """``MXAutogradMarkVariables``: declare leaf variables with grad buffers."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._ag_entry = ("var", None)
+        v.grad = g
+        v._grad_req = req
+
+
+def _toposort(heads) -> List[TapeNode]:
+    order: List[TapeNode] = []
+    seen = set()
+
+    def visit(nd_arr):
+        entry = getattr(nd_arr, "_ag_entry", None)
+        if entry is None or entry[0] == "var":
+            return
+        node = entry[0]
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for inp in node.inputs[:node.n_args]:
+            visit(inp)
+        order.append(node)
+
+    for h in heads:
+        visit(h)
+    return order
+
+
+def backward(heads: Sequence[Any], head_grads: Optional[Sequence[Any]] = None,
+             retain_graph: bool = False, train_mode: bool = True) -> None:
+    """``MXAutogradBackward``: accumulate gradients into marked variables'
+    grad buffers."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
+    order = _toposort(heads)
+
+    # cotangent accumulator keyed by producing (node, out_idx); gradients for
+    # marked leaf variables accumulate in var_accum and are committed at the
+    # end per grad_req (write = overwrite previous backward; within one
+    # backward all paths always sum — reference engine kAddTo semantics)
+    cotan: Dict[Any, Any] = {}
+    var_accum: Dict[int, Any] = {}
+    var_objs: Dict[int, Any] = {}
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    for h, hg in zip(heads, head_grads):
+        g = (jnp.ones(h.shape, dtype=h.data.dtype) if hg is None
+             else (hg.data if isinstance(hg, NDArray) else jnp.asarray(hg)))
+        entry = getattr(h, "_ag_entry", None)
+        if entry is not None and entry[0] == "var":
+            var_accum[id(h)] = var_accum.get(id(h), 0) + g
+            var_objs[id(h)] = h
+            continue
+        key = _entry_key(h)
+        cotan[key] = cotan.get(key, 0) + g
+
+    for node in reversed(order):
+        nid = id(node)
+        if not any(k[0] == nid for k in cotan):
+            continue
+
+        primals = tuple(node.input_vals[:node.n_args])
+        aux_vals = tuple(node.input_vals[node.n_args:])
+
+        def fwd(*args, _node=node, _aux=aux_vals):
+            outs, _ = _node.op.apply(list(args) + list(_aux), _node.attrs,
+                                     _node.opctx)
+            return tuple(outs)
+
+        out_primals, vjp_fn = jax.vjp(fwd, *primals)
+        # cotangent count must match the op's true output count, which only
+        # the forward knows (e.g. topk ret_typ-dependent outputs)
+        full_ct = tuple(
+            cotan.get((nid, i), None) if cotan.get((nid, i), None) is not None
+            else jnp.zeros_like(op_)
+            for i, op_ in enumerate(out_primals))
+        in_grads = vjp_fn(full_ct)
+
+        for inp, g in zip(node.inputs[:node.n_args], in_grads):
+            entry = getattr(inp, "_ag_entry", None)
+            if entry is None:
+                continue
+            if entry[0] == "var":
+                if inp._grad_req == "null" or inp.grad is None:
+                    continue
+                var_accum[id(inp)] = var_accum.get(id(inp), 0) + g
+                var_objs[id(inp)] = inp
+            else:
+                key = (id(entry[0]), entry[1])
+                cotan[key] = (cotan[key] + g) if key in cotan else g
+
+    for vid, g in var_accum.items():
+        v = var_objs[vid]
+        if v.grad is None or v._grad_req == "null":
+            continue
+        if v._grad_req == "add":
+            v.grad._set_data(v.grad.data + g)
+        else:
+            v.grad._set_data(
+                (g if not hasattr(g, "astype") else
+                 g.astype(v.grad.data.dtype)))
+
+
+def _entry_key(nd_arr):
+    entry = getattr(nd_arr, "_ag_entry", None)
+    if entry is None or entry[0] == "var":
+        return ("head", id(nd_arr))
+    return (id(entry[0]), entry[1])
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """``autograd.grad`` — return grads instead of accumulating into buffers."""
+    from .ndarray.ndarray import NDArray
+
+    import jax.numpy as jnp
+
+    saved = [(v.grad, v._grad_req, getattr(v, "_ag_entry", None))
+             for v in variables]
+    grads = [NDArray(jnp.zeros(v.shape, dtype=v.data.dtype), ctx=v._ctx)
+             for v in variables]
+    mark_variables(variables, grads)
+    backward(heads if isinstance(heads, (list, tuple)) else [heads],
+             head_grads, retain_graph=bool(retain_graph),
+             train_mode=train_mode)
+    for v, (g, req, entry) in zip(variables, saved):
+        v.grad, v._grad_req = g, req
+        if entry is not None:
+            v._ag_entry = entry
+    return grads

@@ -1,0 +1,188 @@
+"""Base utilities for the TPU-native MXNet rebuild.
+
+Provides the capabilities MXNet sourced from the (absent) ``dmlc-core``
+submodule: env-var config (``dmlc::GetEnv``), logging/``CHECK_*`` macros,
+registries, and dtype plumbing.  See reference ``include/mxnet/base.h`` and
+SURVEY.md layer 0.
+
+This file is an original TPU-first design, not a translation: there is no
+ctypes/C-ABI layer because the compute substrate is JAX/XLA, which is already
+in-process.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "MXNetError", "check", "get_env", "string_types", "numeric_types",
+    "Registry", "mx_real_t", "dtype_np", "dtype_name", "_Null", "_NullType",
+]
+
+# ---------------------------------------------------------------------------
+# Errors / logging (dmlc-core LOG/CHECK equivalents)
+# ---------------------------------------------------------------------------
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (mirrors ``dmlc::Error`` / MXNetError in the
+    reference C API, ``src/c_api/c_api_error.cc``)."""
+
+
+def check(cond: bool, msg: str = "check failed") -> None:
+    """``CHECK(cond) << msg`` equivalent."""
+    if not cond:
+        raise MXNetError(msg)
+
+
+logger = logging.getLogger("mxnet_tpu")
+
+
+# ---------------------------------------------------------------------------
+# Env-var config registry (``dmlc::GetEnv``; docs/how_to/env_var.md)
+# ---------------------------------------------------------------------------
+
+_ENV_PREFIXES = ("MXNET_", "TP_")
+
+
+def get_env(name: str, default: Any = None, typ: type = str) -> Any:
+    """Read a config env var.  Accepts both the reference's ``MXNET_*`` names
+    (so reference-era scripts keep working) and native ``TP_*`` names.
+
+    ``get_env("ENGINE_TYPE", "ThreadedEnginePerDevice")`` checks
+    ``TP_ENGINE_TYPE`` then ``MXNET_ENGINE_TYPE``.
+    """
+    for prefix in ("TP_", "MXNET_"):
+        v = os.environ.get(prefix + name)
+        if v is not None:
+            if typ is bool:
+                return v not in ("0", "false", "False", "")
+            return typ(v)
+    return default
+
+
+# ---------------------------------------------------------------------------
+# Generic registry (mirrors dmlc registry used by ops/optimizers/metrics/inits)
+# ---------------------------------------------------------------------------
+
+
+class Registry:
+    """Name → object registry with decorator support.
+
+    Equivalent in capability to the dmlc registry pattern used throughout the
+    reference (e.g. ``python/mxnet/registry.py``, optimizer registry at
+    ``python/mxnet/optimizer.py:30``).
+    """
+
+    def __init__(self, kind: str, case_sensitive: bool = False):
+        self.kind = kind
+        self.case_sensitive = case_sensitive
+        self._store: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, name: str) -> str:
+        return name if self.case_sensitive else name.lower()
+
+    def register(self, obj: Any = None, name: Optional[str] = None):
+        def _do(o):
+            key = self._key(name or getattr(o, "__name__", None) or str(o))
+            with self._lock:
+                if key in self._store and self._store[key] is not o:
+                    logger.warning("%s '%s' overridden", self.kind, key)
+                self._store[key] = o
+            return o
+
+        if obj is None:
+            return _do
+        return _do(obj)
+
+    def alias(self, name: str, target: str) -> None:
+        self._store[self._key(name)] = self._store[self._key(target)]
+
+    def get(self, name: str) -> Any:
+        key = self._key(name)
+        if key not in self._store:
+            raise MXNetError(
+                "unknown %s '%s'; registered: %s"
+                % (self.kind, name, sorted(self._store)))
+        return self._store[key]
+
+    def find(self, name: str) -> Optional[Any]:
+        return self._store.get(self._key(name))
+
+    def __contains__(self, name: str) -> bool:
+        return self._key(name) in self._store
+
+    def keys(self):
+        return sorted(self._store)
+
+    def create(self, name: str, *args, **kwargs):
+        return self.get(name)(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# dtypes (mirrors mshadow dtype switch; include/mxnet/base.h:128-134)
+# ---------------------------------------------------------------------------
+
+mx_real_t = np.float32
+
+_DTYPE_ALIASES: Dict[str, np.dtype] = {
+    "float32": np.dtype(np.float32),
+    "float64": np.dtype(np.float64),
+    "float16": np.dtype(np.float16),
+    "bfloat16": None,  # filled lazily to avoid importing jax at module import
+    "uint8": np.dtype(np.uint8),
+    "int8": np.dtype(np.int8),
+    "int32": np.dtype(np.int32),
+    "int64": np.dtype(np.int64),
+    "bool": np.dtype(np.bool_),
+}
+
+
+def dtype_np(dtype) -> Any:
+    """Normalize a user-facing dtype (str | np.dtype | type) to a numpy/ml
+    dtype object usable by jax."""
+    if dtype is None:
+        return np.dtype(mx_real_t)
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            import ml_dtypes  # shipped with jax
+
+            return np.dtype(ml_dtypes.bfloat16)
+        if dtype in _DTYPE_ALIASES and _DTYPE_ALIASES[dtype] is not None:
+            return _DTYPE_ALIASES[dtype]
+        return np.dtype(dtype)
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+    return d.name
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+
+
+class _NullType:
+    """Placeholder for missing op attrs (mirrors mxnet.base._Null)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "_Null"
+
+    def __bool__(self):
+        return False
+
+
+_Null = _NullType()

@@ -1,0 +1,143 @@
+"""Device context model.
+
+Mirrors the reference's ``Context`` (``include/mxnet/base.h:53-…``,
+``python/mxnet/context.py``): ``mx.cpu()``, ``mx.tpu(i)`` (the reference's
+``mx.gpu(i)`` aliases to TPU here so reference scripts run unmodified), and
+``with ctx:`` scoping.
+
+TPU-first design: a Context resolves to a concrete ``jax.Device``.  When the
+requested platform is unavailable (e.g. tests forced onto CPU with
+``JAX_PLATFORMS=cpu``), accelerator contexts transparently fall back to host
+devices — this mirrors the reference test strategy where "CPU Context stands
+in for any device" in graph-partition tests (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "tpu", "gpu", "cpu_pinned", "current_context",
+           "num_tpus", "num_gpus"]
+
+
+class Context:
+    """A device context ``(device_type, device_id)``."""
+
+    devtype2str = {1: "cpu", 2: "tpu", 3: "cpu_pinned", 4: "cpu_shared"}
+    devstr2type = {"cpu": 1, "tpu": 2, "gpu": 2, "cpu_pinned": 3,
+                   "cpu_shared": 4}
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            if device_type not in self.devstr2type:
+                raise MXNetError("unknown device type %s" % device_type)
+            self.device_typeid = self.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx: Optional["Context"] = None
+
+    @property
+    def device_type(self) -> str:
+        return self.devtype2str[self.device_typeid]
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_typeid == other.device_typeid
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __str__ = __repr__
+
+    # -- `with ctx:` scoping (python/mxnet/context.py:80-96 equivalent) -----
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "value"):
+            Context._default_ctx.value = Context("cpu", 0)
+        self._old_ctx = Context._default_ctx.value
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        Context._default_ctx.value = self._old_ctx
+
+    # -- jax resolution ----------------------------------------------------
+    @property
+    def jax_device(self):
+        """Resolve to a concrete jax.Device.
+
+        tpu → jax accelerator device[i] when present, else host device[i]
+        (CPU stand-in, as in the reference multi-device tests).  cpu → host
+        device[i % n] so cpu(0)/cpu(1) shard graphs even on one host.
+        """
+        import jax
+
+        if self.device_type in ("tpu",):
+            accel = _accelerator_devices()
+            if accel:
+                return accel[self.device_id % len(accel)]
+            host = jax.devices("cpu")
+            return host[self.device_id % len(host)]
+        host = jax.devices("cpu") if _has_cpu() else jax.devices()
+        return host[self.device_id % len(host)]
+
+    def empty_cache(self):
+        """Best-effort device allocator cache release (reference
+        ``Context::empty_cache`` analog — XLA owns the allocator, so this is
+        advisory)."""
+        import gc
+
+        gc.collect()
+
+
+def _accelerator_devices():
+    import jax
+
+    devs = jax.devices()
+    return [d for d in devs if d.platform != "cpu"]
+
+
+def _has_cpu() -> bool:
+    import jax
+
+    try:
+        return bool(jax.devices("cpu"))
+    except RuntimeError:
+        return False
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+#: Reference-compat alias — ``mx.gpu(i)`` maps to the TPU device.
+gpu = tpu
+
+
+def num_tpus() -> int:
+    return len(_accelerator_devices())
+
+
+num_gpus = num_tpus
+
+
+def current_context() -> Context:
+    if not hasattr(Context._default_ctx, "value"):
+        Context._default_ctx.value = Context("cpu", 0)
+    return Context._default_ctx.value

@@ -1,0 +1,105 @@
+"""Execution-engine facade.
+
+The reference's dependency engine (``src/engine/threaded_engine*.cc``) exists
+because CUDA streams need explicit dataflow ordering across host threads.  On
+TPU, JAX's asynchronous dispatch + XLA give the same dataflow-async execution
+model natively (SURVEY.md §7 design mapping), so this module is a *thin*
+facade that preserves the reference's observable semantics:
+
+- ``MXNET_ENGINE_TYPE=NaiveEngine`` (or ``TP_ENGINE_TYPE=naive``): every op
+  blocks until complete — the race-free debugging oracle the reference
+  documents at ``src/engine/threaded_engine.h:347-355``.
+- ``wait_to_read`` / ``waitall``: ``jax.block_until_ready`` fences, matching
+  ``Engine::WaitForVar`` / ``WaitForAll`` (``include/mxnet/engine.h:161-170``).
+- a per-op profiler hook (mirrors ``ExecuteOprBlock``'s ``OprExecStat``
+  capture, ``src/engine/threaded_engine.h:312-361``).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional
+
+from .base import get_env
+
+__all__ = ["Engine", "engine", "naive_mode", "waitall"]
+
+
+class Engine:
+    """Singleton op-dispatch facade (``Engine::Get()`` analog)."""
+
+    _instance: Optional["Engine"] = None
+
+    def __init__(self):
+        etype = (get_env("ENGINE_TYPE", "ThreadedEnginePerDevice") or "").lower()
+        self.naive = etype in ("naiveengine", "naive")
+        self._profile_hooks: List[Callable[[str, float, float], None]] = []
+        # bounded window of recently dispatched results so WaitForAll can
+        # fence on (and surface async errors from) in-flight computations
+        from collections import deque
+
+        self._inflight = deque(maxlen=int(get_env("ENGINE_INFLIGHT_WINDOW",
+                                                  256, int)))
+
+    @classmethod
+    def get(cls) -> "Engine":
+        if cls._instance is None:
+            cls._instance = Engine()
+        return cls._instance
+
+    # -- dispatch ----------------------------------------------------------
+    def push(self, fn: Callable[[], Any], name: str = "op") -> Any:
+        """Run an op.  JAX already dispatches asynchronously; in naive mode we
+        additionally fence so errors surface at the faulting op."""
+        if self._profile_hooks:
+            t0 = time.perf_counter()
+            out = fn()
+            if self.naive:
+                out = _block(out)
+            t1 = time.perf_counter()
+            for hook in self._profile_hooks:
+                hook(name, t0, t1)
+            self._inflight.append(out)
+            return out
+        out = fn()
+        if self.naive:
+            out = _block(out)
+        else:
+            self._inflight.append(out)
+        return out
+
+    def wait_for_var(self, data) -> None:
+        _block(data)
+
+    def wait_for_all(self) -> None:
+        """Block on recently dispatched work, surfacing any async error here
+        (``Engine::WaitForAll`` contract)."""
+        while self._inflight:
+            _block(self._inflight.popleft())
+
+    # -- profiler hook (engine-level per-op stats) -------------------------
+    def add_profile_hook(self, hook) -> None:
+        self._profile_hooks.append(hook)
+
+    def remove_profile_hook(self, hook) -> None:
+        if hook in self._profile_hooks:
+            self._profile_hooks.remove(hook)
+
+
+def _block(out):
+    import jax
+
+    return jax.block_until_ready(out)
+
+
+def engine() -> Engine:
+    return Engine.get()
+
+
+def naive_mode() -> bool:
+    return Engine.get().naive
+
+
+def waitall() -> None:
+    """``mx.nd.waitall()`` — block until all queued work completes
+    (``MXNDArrayWaitAll`` equivalent)."""
+    Engine.get().wait_for_all()

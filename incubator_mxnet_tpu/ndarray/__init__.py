@@ -1,0 +1,257 @@
+"""``mx.nd`` — imperative op namespace, auto-generated from the op registry.
+
+Reference analog: ``python/mxnet/ndarray.py`` ops generated at import from the
+C op registry via ``_init_ndarray_module``; each call is one
+``MXImperativeInvoke`` (``src/c_api/c_api_ndarray.cc:423``).  Here the invoke
+path is: unwrap jax arrays → OpContext (train flag + PRNG key) → op forward
+(async jax dispatch) → wrap outputs → optional autograd tape record.
+"""
+from __future__ import annotations
+
+import struct
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import autograd as _autograd
+from .. import random as _random
+from ..base import MXNetError, dtype_np
+from ..context import Context, current_context
+from ..engine import engine
+from ..ops.registry import OPS, OpContext, OpDef, get_op
+from .ndarray import NDArray, array, empty, waitall
+
+__all__ = ["NDArray", "array", "empty", "waitall", "op_invoke", "zeros",
+           "ones", "full", "arange", "save", "load", "concatenate",
+           "onehot_encode", "imports_done"]
+
+
+def op_invoke(op, inputs: Sequence[NDArray], attrs: Optional[Dict] = None,
+              out=None):
+    """Invoke one operator imperatively (MXImperativeInvoke analog)."""
+    opdef: OpDef = op if isinstance(op, OpDef) else get_op(op)
+    attrs = dict(attrs or {})
+    ctx = inputs[0].context if inputs else attrs.pop("ctx", None) or \
+        attrs.pop("context", None) or current_context()
+    if isinstance(ctx, str):
+        parts = ctx.split("(")
+        ctx = Context(parts[0], int(parts[1][:-1]) if len(parts) > 1 else 0)
+
+    in_vals = [a.data for a in inputs]
+    opctx = OpContext(
+        is_train=_autograd.is_training(),
+        rng=_random.next_key() if opdef.needs_rng else None)
+
+    def _run():
+        return opdef.apply(in_vals, attrs, opctx)
+
+    outs, new_aux = engine().push(_run, name=opdef.name)
+
+    arg_names = opdef.get_arg_names(attrs)
+    n_args = len(arg_names) if arg_names is not None else len(inputs)
+    if opdef.has_aux:
+        # NB: can't use builtin min() here — generated ops shadow it in this
+        # module's namespace
+        cap = len(inputs) - len(opdef.aux_names)
+        if cap < n_args:
+            n_args = cap
+        # write aux updates back in place (reference mutates aux NDArrays)
+        for aux_nd, val in zip(inputs[n_args:], new_aux):
+            aux_nd._set_data(val)
+
+    if opdef.mutate_inputs:
+        for i, inp_idx in enumerate(opdef.mutate_inputs):
+            if i < len(outs) and inp_idx < len(inputs):
+                if inp_idx == opdef.mutate_inputs[0] and out is not None:
+                    continue
+                inputs[inp_idx]._set_data(outs[i])
+
+    out_nds = [NDArray(o, ctx=ctx if inputs else ctx) for o in outs]
+
+    if out is not None:
+        targets = out if isinstance(out, (list, tuple)) else [out]
+        for t, o in zip(targets, out_nds):
+            t._set_data(o.data)
+        out_nds = list(targets) + out_nds[len(targets):]
+
+    if _autograd.is_recording() and inputs and not opdef.mutate_inputs:
+        _autograd.record_op(opdef, attrs, opctx, inputs, in_vals, out_nds,
+                            n_args)
+
+    if len(out_nds) == 1:
+        return out_nds[0]
+    return out_nds
+
+
+def _make_op_func(opdef: OpDef, name: str):
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        # split NDArray inputs from attrs
+        inputs: List[NDArray] = [a for a in args if isinstance(a, NDArray)]
+        attrs = {k: v for k, v in kwargs.items()
+                 if not isinstance(v, NDArray)}
+        arg_names = opdef.get_arg_names(attrs)
+        if arg_names is not None:
+            expected = list(arg_names) + list(opdef.aux_names)
+            by_name = {k: v for k, v in kwargs.items()
+                       if isinstance(v, NDArray)}
+            merged: List[NDArray] = list(inputs)
+            for nm in expected[len(merged):]:
+                if nm in by_name:
+                    merged.append(by_name[nm])
+            inputs = merged
+        else:
+            inputs += [v for k, v in kwargs.items() if isinstance(v, NDArray)]
+        # numpy/scalar positional data for creation-style usage
+        return op_invoke(opdef, inputs, attrs, out=out)
+
+    fn.__name__ = name
+    fn.__doc__ = opdef.doc
+    fn.__module__ = __name__
+    return fn
+
+
+def _install_ops():
+    mod = sys.modules[__name__]
+    seen = {}
+    for name in OPS.keys():
+        opdef = OPS.get(name)
+        public = opdef.name
+        # install under every registered alias, preserving case via opdef
+        for alias in [opdef.name] + opdef.aliases:
+            if not hasattr(mod, alias):
+                setattr(mod, alias, _make_op_func(opdef, alias))
+        if name != opdef.name.lower() and not hasattr(mod, name):
+            setattr(mod, name, _make_op_func(opdef, name))
+        seen[public] = opdef
+
+
+_install_ops()
+imports_done = True
+
+
+# ---------------------------------------------------------------------------
+# creation helpers with ctx (python/mxnet/ndarray.py zeros/ones/arange...)
+# ---------------------------------------------------------------------------
+
+
+def _ctx_put(arr, ctx: Optional[Context]):
+    import jax
+
+    ctx = ctx or current_context()
+    return NDArray(jax.device_put(arr, ctx.jax_device), ctx=ctx)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    import jax.numpy as jnp
+
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _ctx_put(jnp.zeros(shape, dtype=dtype_np(dtype)), ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    import jax.numpy as jnp
+
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _ctx_put(jnp.ones(shape, dtype=dtype_np(dtype)), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, **kwargs):
+    import jax.numpy as jnp
+
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _ctx_put(jnp.full(shape, val, dtype=dtype_np(dtype)), ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    import jax.numpy as jnp
+
+    if stop is None:
+        start, stop = 0, start
+    out = jnp.arange(start, stop, step, dtype=dtype_np(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return _ctx_put(out, ctx)
+
+
+def concatenate(arrays: Sequence[NDArray], axis: int = 0,
+                always_copy: bool = True) -> NDArray:
+    import jax.numpy as jnp
+
+    return NDArray(jnp.concatenate([a.data for a in arrays], axis=axis),
+                   ctx=arrays[0]._ctx)
+
+
+def onehot_encode(indices: NDArray, out: NDArray) -> NDArray:
+    import jax
+
+    depth = out.shape[1]
+    oh = jax.nn.one_hot(indices.data.astype("int32"), depth,
+                        dtype=out.data.dtype)
+    out._set_data(oh)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Serialization (NDArray container format analog of
+# ``src/ndarray/ndarray.cc:668-744`` — magic + per-array shape/dtype/data;
+# same two-call API ``mx.nd.save/load``)
+# ---------------------------------------------------------------------------
+
+_NDARRAY_MAGIC = 0x112
+_FMT_VERSION = 1
+
+
+def save(fname: str, data) -> None:
+    """Save dict/list of NDArrays (``MXNDArraySave``)."""
+    if isinstance(data, NDArray):
+        names, arrays = [""], [data]
+    elif isinstance(data, dict):
+        names, arrays = list(data.keys()), list(data.values())
+    else:
+        names, arrays = [""] * len(data), list(data)
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQQ", _NDARRAY_MAGIC, _FMT_VERSION,
+                            len(arrays)))
+        for name, arr in zip(names, arrays):
+            nb = name.encode("utf-8")
+            a = arr.asnumpy()
+            dt = a.dtype.name.encode("utf-8")
+            f.write(struct.pack("<I", len(nb)))
+            f.write(nb)
+            f.write(struct.pack("<I", len(dt)))
+            f.write(dt)
+            f.write(struct.pack("<I", a.ndim))
+            f.write(struct.pack("<%dq" % a.ndim, *a.shape))
+            buf = np.ascontiguousarray(a).tobytes()
+            f.write(struct.pack("<Q", len(buf)))
+            f.write(buf)
+
+
+def load(fname: str):
+    """Load dict/list of NDArrays (``MXNDArrayLoad``)."""
+    with open(fname, "rb") as f:
+        magic, _ver, count = struct.unpack("<QQQ", f.read(24))
+        if magic != _NDARRAY_MAGIC:
+            raise MXNetError("invalid NDArray file %s" % fname)
+        names, arrays = [], []
+        for _ in range(count):
+            (nlen,) = struct.unpack("<I", f.read(4))
+            name = f.read(nlen).decode("utf-8")
+            (dlen,) = struct.unpack("<I", f.read(4))
+            dt = np.dtype(f.read(dlen).decode("utf-8"))
+            (ndim,) = struct.unpack("<I", f.read(4))
+            shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim \
+                else ()
+            (blen,) = struct.unpack("<Q", f.read(8))
+            a = np.frombuffer(f.read(blen), dtype=dt).reshape(shape)
+            names.append(name)
+            arrays.append(array(a, dtype=dt))
+    if any(names):
+        return dict(zip(names, arrays))
+    return arrays

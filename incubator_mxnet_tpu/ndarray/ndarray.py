@@ -1,0 +1,449 @@
+"""NDArray — the imperative tensor.
+
+Reference analog: ``NDArray`` (``include/mxnet/ndarray.h:77``,
+``src/ndarray/ndarray.cc``): a ref-counted async tensor whose mutations are
+engine ops.  TPU-native redesign: wraps an immutable ``jax.Array``; "mutation"
+rebinds the wrapper (functional update), which composes with JAX async
+dispatch exactly like engine write-deps composed with CUDA streams.  Views
+(``Slice/At/Reshape`` share storage in the reference, ``ndarray.h:156-172``)
+are write-through proxies onto their base array.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError, dtype_np
+from ..context import Context, current_context
+from ..engine import engine
+
+__all__ = ["NDArray", "array", "empty", "waitall"]
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+class NDArray:
+    """Imperative tensor on a device context."""
+
+    __slots__ = ("_data", "_base", "_viewspec", "_ctx", "grad", "_grad_req",
+                 "_ag_entry", "__weakref__")
+
+    def __init__(self, data, ctx: Optional[Context] = None,
+                 _base: "NDArray" = None, _viewspec=None):
+        self._base = _base
+        self._viewspec = _viewspec
+        self._ctx = ctx
+        self.grad: Optional["NDArray"] = None
+        self._grad_req = "null"
+        self._ag_entry = None
+        if _base is None:
+            self._data = data
+        else:
+            self._data = None
+
+    # ------------------------------------------------------------------ data
+    @property
+    def data(self):
+        """The underlying jax.Array (view-aware read)."""
+        if self._base is None:
+            return self._data
+        kind, spec = self._viewspec
+        base = self._base.data
+        if kind == "index":
+            return base[spec]
+        if kind == "reshape":
+            return base.reshape(spec)
+        raise MXNetError("bad viewspec")
+
+    def _set_data(self, value) -> None:
+        """Write-through functional mutation (engine write-dep analog)."""
+        if self._base is None:
+            self._data = value
+            return
+        kind, spec = self._viewspec
+        base = self._base
+        if kind == "index":
+            import jax.numpy as jnp
+
+            base._set_data(base.data.at[spec].set(
+                jnp.asarray(value, dtype=base.data.dtype)))
+        elif kind == "reshape":
+            base._set_data(value.reshape(base.data.shape))
+        else:
+            raise MXNetError("bad viewspec")
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self.data.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def context(self) -> Context:
+        if self._ctx is not None:
+            return self._ctx
+        dev = None
+        try:
+            devs = self.data.devices()
+            dev = next(iter(devs))
+        except Exception:
+            pass
+        if dev is not None and dev.platform != "cpu":
+            return Context("tpu", dev.id)
+        return Context("cpu", dev.id if dev is not None else 0)
+
+    ctx = context
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    def __repr__(self) -> str:
+        return "%s\n<NDArray %s @%s>" % (
+            str(self.asnumpy()), "x".join(str(s) for s in self.shape),
+            self.context)
+
+    # ------------------------------------------------------------- transfers
+    def asnumpy(self) -> np.ndarray:
+        """Blocking copy to host (``WaitToRead`` + copy,
+        ``MXNDArraySyncCopyToCPU``)."""
+        return np.asarray(self.data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("asscalar requires size-1 array")
+        return self.asnumpy().reshape(-1)[0]
+
+    def item(self):
+        return self.asscalar()
+
+    def astype(self, dtype) -> "NDArray":
+        return NDArray(self.data.astype(dtype_np(dtype)), ctx=self._ctx)
+
+    def copy(self) -> "NDArray":
+        return NDArray(_jax().numpy.array(self.data), ctx=self._ctx)
+
+    def copyto(self, other) -> "NDArray":
+        """Copy to another NDArray (in-place write) or Context (new array)."""
+        if isinstance(other, Context):
+            return NDArray(_jax().device_put(self.data, other.jax_device),
+                           ctx=other)
+        if isinstance(other, NDArray):
+            dev = other.context.jax_device
+            other._set_data(_jax().device_put(
+                self.data.astype(other.data.dtype).reshape(other.shape), dev))
+            return other
+        raise MXNetError("copyto target must be NDArray or Context")
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.context:
+            return self
+        return self.copyto(ctx)
+
+    def wait_to_read(self) -> None:
+        engine().wait_for_var(self.data)
+
+    def wait_to_write(self) -> None:
+        engine().wait_for_var(self.data)
+
+    # ------------------------------------------------------------- reshaping
+    @staticmethod
+    def _recording() -> bool:
+        from .. import autograd
+
+        return autograd.is_recording()
+
+    def reshape(self, *shape) -> "NDArray":
+        """Storage-sharing reshape view (``NDArray::Reshape``).  Under
+        autograd recording this routes through the Reshape op so the tape
+        sees it (the reference records reshape as an op too)."""
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if self._recording():
+            from . import op_invoke
+
+            return op_invoke("Reshape", [self], {"shape": shape})
+        from ..ops.matrix import _infer_reshape
+
+        tgt = _infer_reshape(self.shape, shape)
+        if self._base is None:
+            return NDArray(None, ctx=self._ctx, _base=self,
+                           _viewspec=("reshape", tgt))
+        return NDArray(self.data.reshape(tgt), ctx=self._ctx)
+
+    def expand_dims(self, axis: int) -> "NDArray":
+        from . import op_invoke
+
+        return op_invoke("expand_dims", [self], {"axis": axis})
+
+    @property
+    def T(self) -> "NDArray":
+        from . import op_invoke
+
+        return op_invoke("transpose", [self])
+
+    def flatten(self) -> "NDArray":
+        from . import op_invoke
+
+        return op_invoke("Flatten", [self])
+
+    # -------------------------------------------------------------- indexing
+    def __getitem__(self, key) -> "NDArray":
+        if self._recording() and isinstance(key, (int, slice)):
+            # route through slice ops so the tape records the dependency
+            from . import op_invoke
+
+            if isinstance(key, int):
+                row = op_invoke("slice_axis", [self],
+                                {"axis": 0, "begin": key, "end": key + 1})
+                return op_invoke("Reshape", [row],
+                                 {"shape": self.shape[1:] or (1,)})
+            return op_invoke("slice_axis", [self],
+                             {"axis": 0, "begin": key.start or 0,
+                              "end": key.stop})
+        if isinstance(key, int):
+            # At(): write-through view of row `key`
+            if self._base is None:
+                return NDArray(None, ctx=self._ctx, _base=self,
+                               _viewspec=("index", key))
+            return NDArray(self.data[key], ctx=self._ctx)
+        if isinstance(key, slice):
+            if key.step is None or key.step == 1:
+                if self._base is None:
+                    return NDArray(None, ctx=self._ctx, _base=self,
+                                   _viewspec=("index", key))
+            return NDArray(self.data[key], ctx=self._ctx)
+        if isinstance(key, NDArray):
+            return NDArray(self.data[key.data.astype("int32")], ctx=self._ctx)
+        return NDArray(self.data[key], ctx=self._ctx)
+
+    def __setitem__(self, key, value) -> None:
+        import jax.numpy as jnp
+
+        if isinstance(value, NDArray):
+            value = value.data
+        if isinstance(value, (int, float)):
+            pass
+        else:
+            value = jnp.asarray(value, dtype=self.data.dtype)
+        if isinstance(key, slice) and key == slice(None):
+            if np.isscalar(value):
+                self._set_data(jnp.full(self.shape, value,
+                                        dtype=self.data.dtype))
+            else:
+                self._set_data(jnp.broadcast_to(value, self.shape).astype(
+                    self.data.dtype))
+            return
+        if isinstance(key, NDArray):
+            key = key.data.astype("int32")
+        self._set_data(self.data.at[key].set(value))
+
+    # ------------------------------------------------------------ arithmetic
+    def _binary(self, other, opname, rop=False):
+        from . import op_invoke
+
+        if isinstance(other, NDArray):
+            return op_invoke(opname, [self, other])
+        scalar_ops = {
+            "elemwise_add": "_plus_scalar",
+            "elemwise_sub": "_rminus_scalar" if rop else "_minus_scalar",
+            "elemwise_mul": "_mul_scalar",
+            "elemwise_div": "_rdiv_scalar" if rop else "_div_scalar",
+            "_mod": "_rmod_scalar" if rop else "_mod_scalar",
+            "_power": "_rpower_scalar" if rop else "_power_scalar",
+            "_equal": "_equal_scalar", "_not_equal": "_not_equal_scalar",
+            "_greater": "_lesser_scalar" if rop else "_greater_scalar",
+            "_greater_equal": "_lesser_equal_scalar" if rop else "_greater_equal_scalar",
+            "_lesser": "_greater_scalar" if rop else "_lesser_scalar",
+            "_lesser_equal": "_greater_equal_scalar" if rop else "_lesser_equal_scalar",
+            "_maximum": "_maximum_scalar", "_minimum": "_minimum_scalar",
+        }
+        return op_invoke(scalar_ops[opname], [self], {"scalar": other})
+
+    def __add__(self, o):
+        return self._binary(o, "elemwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elemwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elemwise_sub", rop=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elemwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elemwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "elemwise_div", rop=True)
+
+    def __mod__(self, o):
+        return self._binary(o, "_mod")
+
+    def __rmod__(self, o):
+        return self._binary(o, "_mod", rop=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "_power")
+
+    def __rpow__(self, o):
+        return self._binary(o, "_power", rop=True)
+
+    def __neg__(self):
+        from . import op_invoke
+
+        return op_invoke("negative", [self])
+
+    def __abs__(self):
+        from . import op_invoke
+
+        return op_invoke("abs", [self])
+
+    def __eq__(self, o):
+        return self._binary(o, "_equal")
+
+    def __ne__(self, o):
+        return self._binary(o, "_not_equal")
+
+    def __gt__(self, o):
+        return self._binary(o, "_greater")
+
+    def __ge__(self, o):
+        return self._binary(o, "_greater_equal")
+
+    def __lt__(self, o):
+        return self._binary(o, "_lesser")
+
+    def __le__(self, o):
+        return self._binary(o, "_lesser_equal")
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("ambiguous truth value of multi-element NDArray")
+
+    # in-place: functional rebind preserving view write-through
+    def __iadd__(self, o):
+        out = self._binary(o, "elemwise_add")
+        self._set_data(out.data)
+        return self
+
+    def __isub__(self, o):
+        out = self._binary(o, "elemwise_sub")
+        self._set_data(out.data)
+        return self
+
+    def __imul__(self, o):
+        out = self._binary(o, "elemwise_mul")
+        self._set_data(out.data)
+        return self
+
+    def __itruediv__(self, o):
+        out = self._binary(o, "elemwise_div")
+        self._set_data(out.data)
+        return self
+
+    # ------------------------------------------------------------- autograd
+    def attach_grad(self, grad_req: str = "write") -> None:
+        """Allocate a gradient buffer and mark for recording
+        (gluon-style; ``MXAutogradMarkVariables`` under the hood)."""
+        from .. import autograd
+
+        import jax.numpy as jnp
+
+        self.grad = NDArray(jnp.zeros_like(self.data), ctx=self._ctx)
+        self._grad_req = grad_req
+        autograd.mark_variables([self], [self.grad], grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self.data, ctx=self._ctx)
+        return out
+
+    # convenience reductions mirroring mx.nd methods
+    def sum(self, *args, **kwargs):
+        from . import op_invoke
+
+        return op_invoke("sum", [self], kwargs)
+
+    def mean(self, *args, **kwargs):
+        from . import op_invoke
+
+        return op_invoke("mean", [self], kwargs)
+
+    def max(self, *args, **kwargs):
+        from . import op_invoke
+
+        return op_invoke("max", [self], kwargs)
+
+    def min(self, *args, **kwargs):
+        from . import op_invoke
+
+        return op_invoke("min", [self], kwargs)
+
+    def argmax(self, **kwargs):
+        from . import op_invoke
+
+        return op_invoke("argmax", [self], kwargs)
+
+    def as_nd_ndarray(self):
+        return self
+
+
+def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    """``mx.nd.array`` — create from any array-like."""
+    import jax
+
+    if isinstance(source, NDArray):
+        source = source.asnumpy()
+    if dtype is None:
+        # reference semantics: numpy keeps its dtype (except float64→float32
+        # the TPU-native default real type), python lists default to float32
+        dtype = source.dtype if isinstance(source, np.ndarray) else np.float32
+        if dtype == np.float64:
+            dtype = np.float32
+    arr = np.asarray(source, dtype=dtype_np(dtype))
+    ctx = ctx or current_context()
+    return NDArray(jax.device_put(arr, ctx.jax_device), ctx=ctx)
+
+
+def empty(shape, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    import jax
+    import jax.numpy as jnp
+
+    ctx = ctx or current_context()
+    return NDArray(jax.device_put(
+        jnp.zeros(shape if isinstance(shape, (tuple, list)) else (shape,),
+                  dtype=dtype_np(dtype)), ctx.jax_device), ctx=ctx)
+
+
+def waitall() -> None:
+    engine().wait_for_all()

@@ -1,0 +1,31 @@
+"""Operator implementations (TPU-native analog of ``src/operator/``).
+
+Every op is registered once in :mod:`.registry` with a pure, jax-traceable
+forward function plus shape-inference metadata; the imperative ``mx.nd``
+namespace and the symbolic ``mx.sym`` namespace are both auto-generated from
+this single registry — the analog of the reference's NNVM op registry that
+feeds both ``MXImperativeInvoke`` and the symbolic executor
+(``src/c_api/c_api_ndarray.cc:423``, SURVEY.md §2.3).
+
+Gradients come from ``jax.vjp`` over the forward function instead of
+hand-written ``FGradient`` registrations — exceptions (e.g. ``SoftmaxOutput``)
+use ``jax.custom_vjp`` where the reference's backward is *not* the true
+derivative.
+"""
+from . import registry  # noqa: F401
+from .registry import OpDef, register, get_op, list_ops  # noqa: F401
+
+# Import op groups for registration side effects.
+from . import elemwise  # noqa: F401
+from . import broadcast_reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import indexing  # noqa: F401
+from . import init_ops  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import ordering  # noqa: F401
+from . import control_flow  # noqa: F401
+from . import sequence  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import linalg  # noqa: F401
+from . import contrib_ops  # noqa: F401

@@ -1,0 +1,107 @@
+"""Reduction + broadcast ops.
+
+Reference analog: ``src/operator/tensor/broadcast_reduce_op*`` with its custom
+CUDA kernels (``broadcast_reduce-inl.cuh``).  On TPU these lower to XLA
+``reduce``/``broadcast_in_dim`` which tile natively onto the VPU — no custom
+kernels required (SURVEY.md §7 "What NOT to rebuild").
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, parse_tuple, parse_bool, parse_int
+
+__all__ = []
+
+
+def _norm_axis(axis, ndim):
+    if axis is None or axis == () or axis == "":
+        return None
+    if isinstance(axis, (int,)):
+        axis = (axis,)
+    axis = parse_tuple(axis)
+    return tuple(a % ndim for a in axis)
+
+
+def _reduce(name, jfn, aliases=()):
+    @register(name, arg_names=["data"], aliases=aliases,
+              doc="reduction %s over `axis` with keepdims/exclude" % name)
+    def _f(ins, attrs, ctx, _j=jfn):
+        x = ins[0]
+        axis = _norm_axis(attrs.get("axis"), x.ndim)
+        if parse_bool(attrs.get("exclude", False)) and axis is not None:
+            axis = tuple(i for i in range(x.ndim) if i not in axis)
+        keepdims = parse_bool(attrs.get("keepdims", False))
+        return _j(x, axis=axis, keepdims=keepdims)
+    return _f
+
+
+_reduce("sum", jnp.sum, aliases=["sum_axis"])
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("max", jnp.max, aliases=["max_axis"])
+_reduce("min", jnp.min, aliases=["min_axis"])
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+
+
+@register("norm", arg_names=["data"])
+def _norm(ins, attrs, ctx):
+    x = ins[0]
+    ord_ = parse_int(attrs.get("ord"), 2)
+    axis = _norm_axis(attrs.get("axis"), x.ndim)
+    keepdims = parse_bool(attrs.get("keepdims", False))
+    if ord_ == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+
+
+def _arg_reduce(name, jfn):
+    @register(name, arg_names=["data"])
+    def _f(ins, attrs, ctx, _j=jfn):
+        x = ins[0]
+        axis = attrs.get("axis")
+        keepdims = parse_bool(attrs.get("keepdims", False))
+        if axis is None or axis == "" :
+            # reference argmax default flattens
+            out = _j(x.reshape(-1), axis=0)
+            return out.astype(jnp.float32)
+        axis = parse_int(axis)
+        out = _j(x, axis=axis)
+        if keepdims:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(jnp.float32)
+    return _f
+
+
+_arg_reduce("argmax", jnp.argmax)
+_arg_reduce("argmin", jnp.argmin)
+
+
+@register("argmax_channel", arg_names=["data"])
+def _argmax_channel(ins, attrs, ctx):
+    return jnp.argmax(ins[0], axis=1).astype(jnp.float32)
+
+
+@register("broadcast_to", arg_names=["data"])
+def _broadcast_to(ins, attrs, ctx):
+    x = ins[0]
+    shape = parse_tuple(attrs.get("shape"))
+    tgt = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_axis", arg_names=["data"], aliases=["broadcast_axes"])
+def _broadcast_axis(ins, attrs, ctx):
+    x = ins[0]
+    axes = parse_tuple(attrs.get("axis"))
+    sizes = parse_tuple(attrs.get("size"))
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("broadcast_like", arg_names=["lhs", "rhs"])
+def _broadcast_like(ins, attrs, ctx):
+    return jnp.broadcast_to(ins[0], ins[1].shape)

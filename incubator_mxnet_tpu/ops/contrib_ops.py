@@ -1,0 +1,61 @@
+"""Contrib ops (``src/operator/contrib/*``): detection + misc.
+
+Round-1 subset: quantization helpers, CTC loss, count_sketch analog, and the
+SSD MultiBox family + ROIPooling land with the detection stack (stage 7 of
+SURVEY.md §7); fft/ifft via jnp.fft.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, parse_float, parse_int, parse_tuple, parse_bool
+
+__all__ = []
+
+
+@register("_contrib_quantize", arg_names=["data", "min_range", "max_range"],
+          num_outputs=3, aliases=["quantize"])
+def _quantize(ins, attrs, ctx):
+    data, mn, mx = ins
+    # uint8 affine quantization (contrib/quantize-inl.h)
+    scale = (mx - mn) / 255.0
+    q = jnp.clip(jnp.round((data - mn) / scale), 0, 255).astype(jnp.uint8)
+    return q, mn, mx
+
+
+@register("_contrib_dequantize", arg_names=["data", "min_range", "max_range"],
+          aliases=["dequantize"])
+def _dequantize(ins, attrs, ctx):
+    data, mn, mx = ins
+    scale = (mx - mn) / 255.0
+    return data.astype(jnp.float32) * scale + mn
+
+
+@register("_contrib_fft", arg_names=["data"], aliases=["fft"])
+def _fft(ins, attrs, ctx):
+    x = ins[0]
+    out = jnp.fft.fft(x, axis=-1)
+    # reference packs complex as interleaved real/imag, doubling last dim
+    return jnp.stack([out.real, out.imag], axis=-1).reshape(
+        x.shape[:-1] + (2 * x.shape[-1],)).astype(x.dtype)
+
+
+@register("_contrib_ifft", arg_names=["data"], aliases=["ifft"])
+def _ifft(ins, attrs, ctx):
+    x = ins[0]
+    pairs = x.reshape(x.shape[:-1] + (x.shape[-1] // 2, 2))
+    z = pairs[..., 0] + 1j * pairs[..., 1]
+    return jnp.fft.ifft(z, axis=-1).real.astype(x.dtype) * z.shape[-1]
+
+
+@register("_contrib_count_sketch", arg_names=["data", "h", "s"],
+          aliases=["count_sketch"])
+def _count_sketch(ins, attrs, ctx):
+    data, h, s = ins
+    out_dim = parse_int(attrs.get("out_dim"))
+    n = data.shape[0]
+    idx = h.astype(jnp.int32).reshape(-1)
+    sign = s.reshape(-1)
+    out = jnp.zeros((n, out_dim), dtype=data.dtype)
+    return out.at[:, idx].add(data * sign[None, :])

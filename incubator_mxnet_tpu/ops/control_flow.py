@@ -1,0 +1,12 @@
+"""Control-flow-adjacent ops (where lives in indexing.py; this module hosts
+the functional control-flow entry points used by RNN fusion: the TPU-native
+replacement for per-timestep graph unrolling is ``lax.scan``)."""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["scan", "cond", "while_loop"]
+
+scan = jax.lax.scan
+cond = jax.lax.cond
+while_loop = jax.lax.while_loop

@@ -1,0 +1,189 @@
+"""Elementwise unary/binary/scalar ops.
+
+Reference analog: ``src/operator/tensor/elemwise_*`` + the ~248 scalar
+functors of ``src/operator/mshadow_op.h`` (SURVEY.md §2.3).  Here each functor
+is a jnp expression; XLA fuses chains of these into single kernels, which is
+the TPU-native replacement for mshadow expression templates.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, parse_float
+
+__all__ = []
+
+
+def _unary(name, jfn, aliases=()):
+    @register(name, arg_names=["data"], aliases=aliases,
+              doc="elementwise %s (mshadow_op.h functor analog)" % name)
+    def _f(ins, attrs, ctx, _j=jfn):
+        return _j(ins[0])
+    return _f
+
+
+def _binary(name, jfn, aliases=()):
+    @register(name, arg_names=["lhs", "rhs"], aliases=aliases,
+              doc="elementwise binary %s" % name)
+    def _f(ins, attrs, ctx, _j=jfn):
+        return _j(ins[0], ins[1])
+    return _f
+
+
+def _binary_scalar(name, jfn, aliases=()):
+    @register(name, arg_names=["data"], aliases=aliases,
+              doc="binary-with-scalar %s" % name)
+    def _f(ins, attrs, ctx, _j=jfn):
+        s = parse_float(attrs.get("scalar", 0.0))
+        x = ins[0]
+        # keep integer arrays integer for whole-number scalars (reference
+        # semantics: output dtype follows the array operand)
+        if jnp.issubdtype(x.dtype, jnp.integer) and float(s).is_integer():
+            s = jnp.asarray(int(s), dtype=x.dtype)
+        else:
+            s = jnp.asarray(s, dtype=x.dtype) \
+                if jnp.issubdtype(x.dtype, jnp.floating) else s
+        return _j(x, s)
+    return _f
+
+
+# -- unary math -------------------------------------------------------------
+_unary("negative", lambda x: -x, aliases=["_np_negative"])
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("round", jnp.round)
+_unary("rint", jnp.rint)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.trunc)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", jax.lax.rsqrt)
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("relu", jax.nn.relu)
+_unary("gamma", lambda x: jnp.exp(jax.lax.lgamma(x)))
+_unary("gammaln", jax.lax.lgamma)
+_unary("erf", jax.lax.erf)
+_unary("erfinv", jax.lax.erf_inv)
+_unary("logical_not", lambda x: (x == 0).astype(x.dtype))
+_unary("ones_like", jnp.ones_like)
+_unary("zeros_like", jnp.zeros_like)
+_unary("make_loss", lambda x: x, aliases=["MakeLoss"])
+_unary("BlockGrad", jax.lax.stop_gradient, aliases=["stop_gradient"])
+_unary("identity", lambda x: x, aliases=["_copy"])
+
+
+@register("Cast", arg_names=["data"], aliases=["cast"])
+def _cast(ins, attrs, ctx):
+    from ..base import dtype_np
+
+    return ins[0].astype(dtype_np(attrs.get("dtype", "float32")))
+
+
+@register("clip", arg_names=["data"])
+def _clip(ins, attrs, ctx):
+    a_min = parse_float(attrs.get("a_min"))
+    a_max = parse_float(attrs.get("a_max"))
+    return jnp.clip(ins[0], a_min, a_max)
+
+
+# -- binary (same-shape in the reference; we broadcast like the broadcast_*
+#    variants so both namespaces share one kernel) --------------------------
+_binary("elemwise_add", jnp.add, aliases=["_plus", "_add", "broadcast_add",
+                                          "broadcast_plus"])
+_binary("elemwise_sub", jnp.subtract, aliases=["_minus", "_sub",
+                                               "broadcast_sub",
+                                               "broadcast_minus"])
+_binary("elemwise_mul", jnp.multiply, aliases=["_mul", "broadcast_mul"])
+_binary("elemwise_div", jnp.divide, aliases=["_div", "broadcast_div"])
+_binary("_mod", jnp.mod, aliases=["broadcast_mod"])
+_binary("_power", jnp.power, aliases=["_pow", "broadcast_power"])
+_binary("_maximum", jnp.maximum, aliases=["broadcast_maximum"])
+_binary("_minimum", jnp.minimum, aliases=["broadcast_minimum"])
+_binary("_hypot", jnp.hypot, aliases=["broadcast_hypot"])
+_binary("_equal", lambda a, b: (a == b).astype(jnp.result_type(a)),
+        aliases=["broadcast_equal"])
+_binary("_not_equal", lambda a, b: (a != b).astype(jnp.result_type(a)),
+        aliases=["broadcast_not_equal"])
+_binary("_greater", lambda a, b: (a > b).astype(jnp.result_type(a)),
+        aliases=["broadcast_greater"])
+_binary("_greater_equal", lambda a, b: (a >= b).astype(jnp.result_type(a)),
+        aliases=["broadcast_greater_equal"])
+_binary("_lesser", lambda a, b: (a < b).astype(jnp.result_type(a)),
+        aliases=["broadcast_lesser"])
+_binary("_lesser_equal", lambda a, b: (a <= b).astype(jnp.result_type(a)),
+        aliases=["broadcast_lesser_equal"])
+_binary("_logical_and", lambda a, b: ((a != 0) & (b != 0)).astype(jnp.result_type(a)),
+        aliases=["broadcast_logical_and"])
+_binary("_logical_or", lambda a, b: ((a != 0) | (b != 0)).astype(jnp.result_type(a)),
+        aliases=["broadcast_logical_or"])
+_binary("_logical_xor", lambda a, b: ((a != 0) ^ (b != 0)).astype(jnp.result_type(a)),
+        aliases=["broadcast_logical_xor"])
+
+
+# -- binary with scalar -----------------------------------------------------
+_binary_scalar("_plus_scalar", jnp.add)
+_binary_scalar("_minus_scalar", jnp.subtract)
+_binary_scalar("_rminus_scalar", lambda x, s: s - x)
+_binary_scalar("_mul_scalar", jnp.multiply)
+_binary_scalar("_div_scalar", jnp.divide)
+_binary_scalar("_rdiv_scalar", lambda x, s: s / x)
+_binary_scalar("_mod_scalar", jnp.mod)
+_binary_scalar("_rmod_scalar", lambda x, s: jnp.mod(s, x))
+_binary_scalar("_power_scalar", jnp.power)
+_binary_scalar("_rpower_scalar", lambda x, s: jnp.power(s, x))
+_binary_scalar("_maximum_scalar", jnp.maximum)
+_binary_scalar("_minimum_scalar", jnp.minimum)
+_binary_scalar("_hypot_scalar", jnp.hypot)
+_binary_scalar("_equal_scalar", lambda x, s: (x == s).astype(x.dtype))
+_binary_scalar("_not_equal_scalar", lambda x, s: (x != s).astype(x.dtype))
+_binary_scalar("_greater_scalar", lambda x, s: (x > s).astype(x.dtype))
+_binary_scalar("_greater_equal_scalar", lambda x, s: (x >= s).astype(x.dtype))
+_binary_scalar("_lesser_scalar", lambda x, s: (x < s).astype(x.dtype))
+_binary_scalar("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype))
+
+
+@register("smooth_l1", arg_names=["data"])
+def _smooth_l1(ins, attrs, ctx):
+    sigma = parse_float(attrs.get("scalar", 1.0))
+    x = ins[0]
+    s2 = sigma * sigma
+    return jnp.where(jnp.abs(x) < 1.0 / s2,
+                     0.5 * s2 * x * x,
+                     jnp.abs(x) - 0.5 / s2)
+
+
+@register("add_n", arg_names=None, aliases=["ElementWiseSum", "_sum"])
+def _add_n(ins, attrs, ctx):
+    """n-ary sum (``src/operator/tensor/elemwise_sum.cc``)."""
+    out = ins[0]
+    for x in ins[1:]:
+        out = out + x
+    return out

@@ -1,0 +1,103 @@
+"""Indexing ops: Embedding, take, one_hot, gather/scatter.
+
+Reference analog: ``src/operator/tensor/indexing_op.{h,cc,cu}``.  Gathers map
+onto XLA ``gather`` which TPU executes natively; no ``AddTakeGrad`` custom
+kernel needed — ``jax.vjp`` of ``take`` emits the scatter-add.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, parse_int, parse_bool, parse_float, parse_tuple
+
+__all__ = []
+
+
+def _embedding_infer_shape(in_shapes, attrs):
+    data_s, weight_s = in_shapes
+    input_dim = parse_int(attrs.get("input_dim"))
+    output_dim = parse_int(attrs.get("output_dim"))
+    if weight_s is None:
+        weight_s = (input_dim, output_dim)
+    out_s = None if data_s is None else tuple(data_s) + (output_dim,)
+    return [data_s, weight_s], [out_s], []
+
+
+@register("Embedding", arg_names=["data", "weight"],
+          infer_shape=_embedding_infer_shape)
+def _embedding(ins, attrs, ctx):
+    """Embedding lookup (``src/operator/tensor/indexing_op.h`` Embedding).
+    Weight shape back-inferred from (input_dim, output_dim) for
+    simple_bind parity."""
+    data, weight = ins
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("take", arg_names=["a", "indices"])
+def _take(ins, attrs, ctx):
+    a, indices = ins
+    axis = parse_int(attrs.get("axis"), 0)
+    mode = attrs.get("mode", "clip")
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("batch_take", arg_names=["a", "indices"])
+def _batch_take(ins, attrs, ctx):
+    a, indices = ins
+    rows = jnp.arange(a.shape[0])
+    return a[rows, indices.astype(jnp.int32)]
+
+
+@register("one_hot", arg_names=["indices"])
+def _one_hot(ins, attrs, ctx):
+    depth = parse_int(attrs.get("depth"))
+    on = parse_float(attrs.get("on_value", 1.0))
+    off = parse_float(attrs.get("off_value", 0.0))
+    from ..base import dtype_np
+
+    dt = dtype_np(attrs.get("dtype", "float32"))
+    oh = jax.nn.one_hot(ins[0].astype(jnp.int32), depth)
+    return (oh * (on - off) + off).astype(dt)
+
+
+@register("gather_nd", arg_names=["data", "indices"])
+def _gather_nd(ins, attrs, ctx):
+    data, indices = ins
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd", arg_names=["data", "indices"])
+def _scatter_nd(ins, attrs, ctx):
+    data, indices = ins
+    shape = parse_tuple(attrs.get("shape"))
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("_scatter_set_nd", arg_names=["lhs", "rhs", "indices"])
+def _scatter_set_nd(ins, attrs, ctx):
+    lhs, rhs, indices = ins
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return lhs.at[tuple(idx[i] for i in range(m))].set(rhs)
+
+
+@register("where", arg_names=["condition", "x", "y"])
+def _where(ins, attrs, ctx):
+    """``src/operator/tensor/control_flow_op.h`` where: condition may be
+    same-shape or a vector over axis 0."""
+    cond, x, y = ins
+    if cond.shape != x.shape and cond.ndim == 1:
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(cond != 0, x, y)

@@ -1,0 +1,58 @@
+"""Creation ops (``src/operator/tensor/init_op.{h,cc}``): zeros/ones/arange…"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from .registry import register, parse_tuple, parse_float, parse_int
+
+__all__ = []
+
+
+def _creation_shape_dtype(attrs):
+    shape = parse_tuple(attrs.get("shape"))
+    dt = dtype_np(attrs.get("dtype", "float32"))
+    return shape, dt
+
+
+@register("_zeros", arg_names=[], aliases=["zeros"])
+def _zeros(ins, attrs, ctx):
+    shape, dt = _creation_shape_dtype(attrs)
+    return jnp.zeros(shape, dtype=dt)
+
+
+@register("_ones", arg_names=[], aliases=["ones"])
+def _ones(ins, attrs, ctx):
+    shape, dt = _creation_shape_dtype(attrs)
+    return jnp.ones(shape, dtype=dt)
+
+
+@register("_full", arg_names=[], aliases=["full"])
+def _full(ins, attrs, ctx):
+    shape, dt = _creation_shape_dtype(attrs)
+    return jnp.full(shape, parse_float(attrs.get("value")), dtype=dt)
+
+
+@register("_arange", arg_names=[], aliases=["arange"])
+def _arange(ins, attrs, ctx):
+    start = parse_float(attrs.get("start", 0.0))
+    stop = attrs.get("stop")
+    stop = None if stop in (None, "None", "") else parse_float(stop)
+    step = parse_float(attrs.get("step", 1.0))
+    repeat = parse_int(attrs.get("repeat"), 1)
+    dt = dtype_np(attrs.get("dtype", "float32"))
+    if stop is None:
+        start, stop = 0.0, start
+    out = jnp.arange(start, stop, step, dtype=dt)
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_eye", arg_names=[], aliases=["eye"])
+def _eye(ins, attrs, ctx):
+    n = parse_int(attrs.get("N"))
+    m = attrs.get("M")
+    m = n if m in (None, "", "0", 0) else parse_int(m)
+    k = parse_int(attrs.get("k"), 0)
+    return jnp.eye(n, m, k=k, dtype=dtype_np(attrs.get("dtype", "float32")))

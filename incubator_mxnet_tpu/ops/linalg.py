@@ -1,0 +1,103 @@
+"""Linear-algebra ops (``src/operator/tensor/la_op.{h,cc}`` backed by LAPACK
+via ``c_lapack_api.h`` in the reference; here backed by
+``jax.numpy.linalg``/``jax.scipy.linalg`` which lower to XLA custom calls)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+from .registry import register, parse_bool, parse_float
+
+__all__ = []
+
+
+@register("_linalg_gemm", arg_names=["A", "B", "C"], aliases=["linalg_gemm"])
+def _gemm(ins, attrs, ctx):
+    a, b, c = ins
+    ta = parse_bool(attrs.get("transpose_a", False))
+    tb = parse_bool(attrs.get("transpose_b", False))
+    alpha = parse_float(attrs.get("alpha", 1.0))
+    beta = parse_float(attrs.get("beta", 1.0))
+    if ta:
+        a = jnp.swapaxes(a, -1, -2)
+    if tb:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b) + beta * c
+
+
+@register("_linalg_gemm2", arg_names=["A", "B"], aliases=["linalg_gemm2"])
+def _gemm2(ins, attrs, ctx):
+    a, b = ins
+    ta = parse_bool(attrs.get("transpose_a", False))
+    tb = parse_bool(attrs.get("transpose_b", False))
+    alpha = parse_float(attrs.get("alpha", 1.0))
+    if ta:
+        a = jnp.swapaxes(a, -1, -2)
+    if tb:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_potrf", arg_names=["A"], aliases=["linalg_potrf"])
+def _potrf(ins, attrs, ctx):
+    return jnp.linalg.cholesky(ins[0])
+
+
+@register("_linalg_potri", arg_names=["A"], aliases=["linalg_potri"])
+def _potri(ins, attrs, ctx):
+    # inverse from cholesky factor L: (L Lᵀ)⁻¹
+    l = ins[0]
+    inv_l = jsl.solve_triangular(l, jnp.broadcast_to(
+        jnp.eye(l.shape[-1], dtype=l.dtype), l.shape), lower=True)
+    return jnp.matmul(jnp.swapaxes(inv_l, -1, -2), inv_l)
+
+
+@register("_linalg_trmm", arg_names=["A", "B"], aliases=["linalg_trmm"])
+def _trmm(ins, attrs, ctx):
+    a, b = ins
+    transpose = parse_bool(attrs.get("transpose", False))
+    rightside = parse_bool(attrs.get("rightside", False))
+    alpha = parse_float(attrs.get("alpha", 1.0))
+    at = jnp.swapaxes(a, -1, -2) if transpose else a
+    return alpha * (jnp.matmul(b, at) if rightside else jnp.matmul(at, b))
+
+
+@register("_linalg_trsm", arg_names=["A", "B"], aliases=["linalg_trsm"])
+def _trsm(ins, attrs, ctx):
+    a, b = ins
+    transpose = parse_bool(attrs.get("transpose", False))
+    rightside = parse_bool(attrs.get("rightside", False))
+    alpha = parse_float(attrs.get("alpha", 1.0))
+    if rightside:
+        # B · A⁻ᵀ' : solve Aᵀ' Xᵀ = Bᵀ with the *lower* factor A; transposing
+        # the system flips the requested transpose flag
+        sol = jsl.solve_triangular(a, jnp.swapaxes(b, -1, -2), lower=True,
+                                   trans=0 if transpose else 1)
+        return alpha * jnp.swapaxes(sol, -1, -2)
+    return alpha * jsl.solve_triangular(a, b, lower=True,
+                                        trans=1 if transpose else 0)
+
+
+@register("_linalg_sumlogdiag", arg_names=["A"], aliases=["linalg_sumlogdiag"])
+def _sumlogdiag(ins, attrs, ctx):
+    a = ins[0]
+    diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register("_linalg_syrk", arg_names=["A"], aliases=["linalg_syrk"])
+def _syrk(ins, attrs, ctx):
+    a = ins[0]
+    transpose = parse_bool(attrs.get("transpose", False))
+    alpha = parse_float(attrs.get("alpha", 1.0))
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register("_linalg_gelqf", arg_names=["A"], aliases=["linalg_gelqf"],
+          num_outputs=2)
+def _gelqf(ins, attrs, ctx):
+    # LQ factorization: A = L Q with Q orthonormal rows
+    q, r = jnp.linalg.qr(jnp.swapaxes(ins[0], -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)

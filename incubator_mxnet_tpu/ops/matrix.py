@@ -1,0 +1,271 @@
+"""Matrix/shape-manipulation ops.
+
+Reference analog: ``src/operator/tensor/matrix_op*`` (dot, transpose, reshape,
+slice, clip, repeat, tile, …; SURVEY.md §2.3).  ``dot`` maps straight onto the
+MXU via ``jax.lax.dot_general``; everything else is metadata-only in XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, parse_tuple, parse_bool, parse_int, parse_float
+
+__all__ = []
+
+
+@register("dot", arg_names=["lhs", "rhs"])
+def _dot(ins, attrs, ctx):
+    a, b = ins
+    ta = parse_bool(attrs.get("transpose_a", False))
+    tb = parse_bool(attrs.get("transpose_b", False))
+    if ta:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if tb:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # reference dot: reduce last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot", arg_names=["lhs", "rhs"])
+def _batch_dot(ins, attrs, ctx):
+    a, b = ins
+    ta = parse_bool(attrs.get("transpose_a", False))
+    tb = parse_bool(attrs.get("transpose_b", False))
+    if ta:
+        a = jnp.swapaxes(a, -1, -2)
+    if tb:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+def _infer_reshape(shape, target):
+    """Implements the reference reshape codes 0 (keep), -1 (infer),
+    -2 (copy rest), -3 (merge two), -4 (split) —
+    ``src/operator/tensor/matrix_op-inl.h`` semantics."""
+    out = []
+    src = list(shape)
+    i = 0
+    t = list(target)
+    j = 0
+    while j < len(t):
+        d = t[j]
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            out.append(-1); i += 1
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            a, b = t[j + 1], t[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(d)
+            if i < len(src):
+                i += 1
+        j += 1
+    if -1 in out:
+        known = int(np.prod([d for d in out if d != -1])) or 1
+        total = int(np.prod(shape)) if shape else 1
+        out[out.index(-1)] = total // known
+    return tuple(out)
+
+
+@register("Reshape", arg_names=["data"], aliases=["reshape"])
+def _reshape(ins, attrs, ctx):
+    x = ins[0]
+    shape = parse_tuple(attrs.get("shape"))
+    if parse_bool(attrs.get("reverse", False)):
+        rev = _infer_reshape(x.shape[::-1], tuple(shape)[::-1])
+        return x.reshape(rev[::-1])
+    return x.reshape(_infer_reshape(x.shape, shape))
+
+
+@register("Flatten", arg_names=["data"], aliases=["flatten"])
+def _flatten(ins, attrs, ctx):
+    x = ins[0]
+    return x.reshape(x.shape[0], -1)
+
+
+@register("transpose", arg_names=["data"])
+def _transpose(ins, attrs, ctx):
+    axes = attrs.get("axes")
+    axes = parse_tuple(axes) if axes not in (None, "", ()) else None
+    return jnp.transpose(ins[0], axes)
+
+
+@register("expand_dims", arg_names=["data"])
+def _expand_dims(ins, attrs, ctx):
+    return jnp.expand_dims(ins[0], parse_int(attrs.get("axis")))
+
+
+@register("squeeze", arg_names=["data"])
+def _squeeze(ins, attrs, ctx):
+    axis = attrs.get("axis")
+    if axis in (None, ""):
+        return jnp.squeeze(ins[0])
+    return jnp.squeeze(ins[0], parse_tuple(axis))
+
+
+@register("slice", arg_names=["data"], aliases=["crop"])
+def _slice(ins, attrs, ctx):
+    x = ins[0]
+    begin = parse_tuple(attrs.get("begin"))
+    end = parse_tuple(attrs.get("end"))
+    step = attrs.get("step")
+    step = parse_tuple(step) if step not in (None, "", ()) else (1,) * len(begin)
+    idx = []
+    for i in range(x.ndim):
+        if i < len(begin):
+            b = begin[i]
+            e = end[i] if i < len(end) else None
+            s = step[i] if i < len(step) else 1
+            b = None if b is None else b
+            idx.append(slice(b, e, s if s != 0 else 1))
+        else:
+            idx.append(slice(None))
+    return x[tuple(idx)]
+
+
+@register("slice_axis", arg_names=["data"])
+def _slice_axis(ins, attrs, ctx):
+    x = ins[0]
+    axis = parse_int(attrs.get("axis"))
+    begin = parse_int(attrs.get("begin"), 0)
+    end = attrs.get("end")
+    end = None if end in (None, "None", "") else parse_int(end)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like", arg_names=["data", "shape_like"])
+def _slice_like(ins, attrs, ctx):
+    x, like = ins
+    axes = attrs.get("axes")
+    axes = parse_tuple(axes) if axes not in (None, "", ()) else tuple(range(x.ndim))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, like.shape[a])
+    return x[tuple(idx)]
+
+
+@register("repeat", arg_names=["data"])
+def _repeat(ins, attrs, ctx):
+    x = ins[0]
+    repeats = parse_int(attrs.get("repeats"))
+    axis = attrs.get("axis")
+    if axis in (None, ""):
+        return jnp.repeat(x.reshape(-1), repeats)
+    return jnp.repeat(x, repeats, axis=parse_int(axis))
+
+
+@register("tile", arg_names=["data"])
+def _tile(ins, attrs, ctx):
+    return jnp.tile(ins[0], parse_tuple(attrs.get("reps")))
+
+
+@register("reverse", arg_names=["data"], aliases=["flip"])
+def _reverse(ins, attrs, ctx):
+    return jnp.flip(ins[0], parse_tuple(attrs.get("axis")))
+
+
+@register("Concat", arg_names=None, aliases=["concat"])
+def _concat(ins, attrs, ctx):
+    dim = parse_int(attrs.get("dim"), 1)
+    return jnp.concatenate(ins, axis=dim)
+
+
+@register("stack", arg_names=None)
+def _stack(ins, attrs, ctx):
+    return jnp.stack(ins, axis=parse_int(attrs.get("axis"), 0))
+
+
+def _split_infer_shape(in_shapes, attrs, n_out):
+    pass
+
+
+@register("SliceChannel", arg_names=["data"], aliases=["split"],
+          num_outputs=-1)
+def _slice_channel(ins, attrs, ctx):
+    """Split along an axis into num_outputs parts
+    (``src/operator/slice_channel-inl.h``)."""
+    x = ins[0]
+    num = parse_int(attrs.get("num_outputs"))
+    axis = parse_int(attrs.get("axis"), 1)
+    squeeze = parse_bool(attrs.get("squeeze_axis", False))
+    parts = jnp.split(x, num, axis=axis)
+    if squeeze:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("SwapAxis", arg_names=["data"], aliases=["swapaxes"])
+def _swapaxes(ins, attrs, ctx):
+    return jnp.swapaxes(ins[0], parse_int(attrs.get("dim1"), 0),
+                        parse_int(attrs.get("dim2"), 0))
+
+
+@register("Pad", arg_names=["data"], aliases=["pad"])
+def _pad(ins, attrs, ctx):
+    """N-D padding (``src/operator/pad-inl.h``): pad_width is
+    (before, after) per axis flattened, mode constant/edge/reflect."""
+    x = ins[0]
+    pw = parse_tuple(attrs.get("pad_width"))
+    mode = attrs.get("mode", "constant")
+    cval = parse_float(attrs.get("constant_value", 0.0))
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    while len(pairs) < x.ndim:
+        pairs.append((0, 0))
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=cval)
+    return jnp.pad(x, pairs, mode={"edge": "edge", "reflect": "reflect"}[mode])
+
+
+@register("L2Normalization", arg_names=["data"])
+def _l2norm(ins, attrs, ctx):
+    x = ins[0]
+    eps = parse_float(attrs.get("eps", 1e-10))
+    mode = attrs.get("mode", "instance")
+    if mode == "instance":
+        axis = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axis = (1,)
+    else:  # spatial
+        axis = tuple(range(2, x.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return x / norm
+
+
+@register("diag", arg_names=["data"])
+def _diag(ins, attrs, ctx):
+    return jnp.diag(ins[0], k=parse_int(attrs.get("k"), 0))
+
+
+@register("space_to_depth", arg_names=["data"])
+def _space_to_depth(ins, attrs, ctx):
+    x = ins[0]
+    bs = parse_int(attrs.get("block_size"))
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return x.reshape(n, c * bs * bs, h // bs, w // bs)
+
+
+@register("depth_to_space", arg_names=["data"])
+def _depth_to_space(ins, attrs, ctx):
+    x = ins[0]
+    bs = parse_int(attrs.get("block_size"))
+    n, c, h, w = x.shape
+    x = x.reshape(n, bs, bs, c // (bs * bs), h, w)
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return x.reshape(n, c // (bs * bs), h * bs, w * bs)

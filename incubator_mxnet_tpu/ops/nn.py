@@ -1,0 +1,718 @@
+"""Neural-network layer ops.
+
+Reference analog: the legacy ``MXNET_REGISTER_OP_PROPERTY`` layers —
+Convolution/FullyConnected/BatchNorm/Pooling/Activation/SoftmaxOutput/… in
+``src/operator/*-inl.h`` with their cuDNN forks (SURVEY.md §2.3).
+
+TPU-native design notes:
+- convs lower to ``lax.conv_general_dilated`` → MXU; XLA picks TPU-optimal
+  layouts internally, so the *logical* layout stays NCHW (reference default)
+  while the physical layout is XLA's choice.  No cuDNN-fork equivalent exists
+  or is needed.
+- loss layers (``SoftmaxOutput`` family) use ``jax.custom_vjp`` because the
+  reference's backward is the loss gradient, not the true derivative of the
+  forward (``src/operator/softmax_output-inl.h``).
+- ``BatchNorm`` aux state (moving mean/var) is threaded functionally: the op
+  returns updated aux, and the executor rebinds them — the functional
+  equivalent of the reference mutating aux NDArrays in-place.
+- shape back-inference rules mirror ``OperatorProperty::InferShape`` so
+  ``simple_bind`` can allocate weights from just the data shape.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import (register, parse_tuple, parse_bool, parse_int,
+                       parse_float)
+
+__all__ = []
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+
+def _fc_args(attrs):
+    if parse_bool(attrs.get("no_bias", False)):
+        return ["data", "weight"]
+    return ["data", "weight", "bias"]
+
+
+def _fc_infer_shape(in_shapes, attrs):
+    num_hidden = parse_int(attrs.get("num_hidden"))
+    no_bias = parse_bool(attrs.get("no_bias", False))
+    flatten = parse_bool(attrs.get("flatten", True))
+    data_s = in_shapes[0]
+    if data_s is not None:
+        in_dim = int(np.prod(data_s[1:])) if flatten else data_s[-1]
+        w = (num_hidden, in_dim)
+        out = (data_s[0], num_hidden) if flatten else tuple(data_s[:-1]) + (num_hidden,)
+    else:
+        w, out = in_shapes[1], None
+    shapes = [data_s, w] + ([] if no_bias else [(num_hidden,)])
+    return shapes, [out], []
+
+
+@register("FullyConnected", arg_names=_fc_args, infer_shape=_fc_infer_shape)
+def _fully_connected(ins, attrs, ctx):
+    """y = x·Wᵀ + b (``src/operator/fully_connected-inl.h``); weight layout
+    (num_hidden, in_dim) as in the reference."""
+    flatten = parse_bool(attrs.get("flatten", True))
+    x = ins[0]
+    w = ins[1]
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    y = jnp.matmul(x, w.T)
+    if len(ins) > 2:
+        y = y + ins[2]
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+
+def _conv_args(attrs):
+    if parse_bool(attrs.get("no_bias", False)):
+        return ["data", "weight"]
+    return ["data", "weight", "bias"]
+
+
+def _conv_out_dim(i, k, s, p, d):
+    return (i + 2 * p - (d * (k - 1) + 1)) // s + 1
+
+
+def _conv_geometry(attrs, nd):
+    kernel = parse_tuple(attrs.get("kernel"), nd)
+    stride = parse_tuple(attrs.get("stride") or (1,) * nd, nd)
+    pad = parse_tuple(attrs.get("pad") or (0,) * nd, nd)
+    dilate = parse_tuple(attrs.get("dilate") or (1,) * nd, nd)
+    return kernel, stride, pad, dilate
+
+
+def _conv_infer_shape(in_shapes, attrs):
+    data_s = in_shapes[0]
+    num_filter = parse_int(attrs.get("num_filter"))
+    num_group = parse_int(attrs.get("num_group"), 1)
+    no_bias = parse_bool(attrs.get("no_bias", False))
+    if data_s is None:
+        return in_shapes, [None], []
+    nd = len(data_s) - 2
+    kernel, stride, pad, dilate = _conv_geometry(attrs, nd)
+    c_in = data_s[1]
+    w = (num_filter, c_in // num_group) + kernel
+    out_sp = tuple(_conv_out_dim(data_s[2 + i], kernel[i], stride[i], pad[i],
+                                 dilate[i]) for i in range(nd))
+    out = (data_s[0], num_filter) + out_sp
+    shapes = [data_s, w] + ([] if no_bias else [(num_filter,)])
+    return shapes, [out], []
+
+
+_CONV_DIMNUMS = {1: ("NCH", "OIH", "NCH"),
+                 2: ("NCHW", "OIHW", "NCHW"),
+                 3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+@register("Convolution", arg_names=_conv_args, infer_shape=_conv_infer_shape,
+          aliases=["Convolution_v1"])
+def _convolution(ins, attrs, ctx):
+    """N-d convolution (``src/operator/convolution-inl.h:490``); maps to one
+    ``lax.conv_general_dilated`` call → MXU."""
+    x, w = ins[0], ins[1]
+    nd = x.ndim - 2
+    kernel, stride, pad, dilate = _conv_geometry(attrs, nd)
+    num_group = parse_int(attrs.get("num_group"), 1)
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=_CONV_DIMNUMS[nd],
+        feature_group_count=num_group)
+    if len(ins) > 2:
+        b = ins[2].reshape((1, -1) + (1,) * nd)
+        y = y + b
+    return y
+
+
+def _deconv_infer_shape(in_shapes, attrs):
+    data_s = in_shapes[0]
+    num_filter = parse_int(attrs.get("num_filter"))
+    num_group = parse_int(attrs.get("num_group"), 1)
+    no_bias = parse_bool(attrs.get("no_bias", True))
+    if data_s is None:
+        return in_shapes, [None], []
+    nd = len(data_s) - 2
+    kernel, stride, pad, dilate = _conv_geometry(attrs, nd)
+    adj = parse_tuple(attrs.get("adj") or (0,) * nd, nd)
+    c_in = data_s[1]
+    w = (c_in, num_filter // num_group) + kernel
+    out_sp = tuple((data_s[2 + i] - 1) * stride[i] - 2 * pad[i]
+                   + (dilate[i] * (kernel[i] - 1) + 1) + adj[i]
+                   for i in range(nd))
+    out = (data_s[0], num_filter) + out_sp
+    shapes = [data_s, w] + ([] if no_bias else [(num_filter,)])
+    return shapes, [out], []
+
+
+@register("Deconvolution", arg_names=_conv_args,
+          infer_shape=_deconv_infer_shape)
+def _deconvolution(ins, attrs, ctx):
+    """Transposed convolution (``src/operator/deconvolution-inl.h``): the
+    gradient of Convolution wrt its input, expressed as lhs-dilated conv."""
+    x, w = ins[0], ins[1]
+    nd = x.ndim - 2
+    kernel, stride, pad, dilate = _conv_geometry(attrs, nd)
+    adj = parse_tuple(attrs.get("adj") or (0,) * nd, nd)
+    num_group = parse_int(attrs.get("num_group"), 1)
+    # weight (C_in, C_out/g, *k) → conv with flipped spatial + swapped io
+    w_t = jnp.swapaxes(w, 0, 1)
+    if num_group > 1:
+        ci, co_g = w.shape[0], w.shape[1]
+        wg = w.reshape((num_group, ci // num_group, co_g) + w.shape[2:])
+        w_t = jnp.concatenate([jnp.swapaxes(g, 0, 1) for g in wg], axis=0)
+    w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + nd)))
+    lo_hi = [(dilate[i] * (kernel[i] - 1) - pad[i],
+              dilate[i] * (kernel[i] - 1) - pad[i] + adj[i])
+             for i in range(nd)]
+    y = jax.lax.conv_general_dilated(
+        x, w_t, window_strides=(1,) * nd,
+        padding=lo_hi, lhs_dilation=stride, rhs_dilation=dilate,
+        dimension_numbers=_CONV_DIMNUMS[nd],
+        feature_group_count=num_group)
+    if len(ins) > 2:
+        y = y + ins[2].reshape((1, -1) + (1,) * nd)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Activation family
+# ---------------------------------------------------------------------------
+
+@register("Activation", arg_names=["data"])
+def _activation(ins, attrs, ctx):
+    act = attrs.get("act_type", "relu")
+    x = ins[0]
+    if act == "relu":
+        return jax.nn.relu(x)
+    if act == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act == "tanh":
+        return jnp.tanh(x)
+    if act == "softrelu":
+        return jax.nn.softplus(x)
+    if act == "softsign":
+        return jax.nn.soft_sign(x)
+    raise ValueError("unknown act_type %s" % act)
+
+
+def _leaky_args(attrs):
+    if attrs.get("act_type", "leaky") == "prelu":
+        return ["data", "gamma"]
+    return ["data"]
+
+
+def _leaky_infer_shape(in_shapes, attrs):
+    data_s = in_shapes[0]
+    if attrs.get("act_type", "leaky") == "prelu":
+        g = (data_s[1],) if data_s is not None else in_shapes[1]
+        return [data_s, g], [data_s], []
+    return [data_s], [data_s], []
+
+
+@register("LeakyReLU", arg_names=_leaky_args, infer_shape=_leaky_infer_shape,
+          needs_rng=True)
+def _leaky_relu(ins, attrs, ctx):
+    """leaky/elu/prelu/rrelu (``src/operator/leaky_relu-inl.h``)."""
+    act = attrs.get("act_type", "leaky")
+    x = ins[0]
+    slope = parse_float(attrs.get("slope", 0.25))
+    if act == "leaky":
+        return jnp.where(x > 0, x, slope * x)
+    if act == "elu":
+        return jnp.where(x > 0, x, slope * (jnp.exp(x) - 1.0))
+    if act == "prelu":
+        g = ins[1].reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x > 0, x, g * x)
+    if act == "rrelu":
+        lo = parse_float(attrs.get("lower_bound", 0.125))
+        hi = parse_float(attrs.get("upper_bound", 0.334))
+        if ctx.is_train and ctx.rng is not None:
+            a = jax.random.uniform(ctx.rng, x.shape, dtype=x.dtype,
+                                   minval=lo, maxval=hi)
+        else:
+            a = (lo + hi) / 2.0
+        return jnp.where(x > 0, x, a * x)
+    raise ValueError("unknown act_type %s" % act)
+
+
+# ---------------------------------------------------------------------------
+# softmax family
+# ---------------------------------------------------------------------------
+
+@register("softmax", arg_names=["data"])
+def _softmax(ins, attrs, ctx):
+    axis = parse_int(attrs.get("axis"), -1)
+    t = attrs.get("temperature")
+    x = ins[0]
+    if t not in (None, "None", ""):
+        x = x / parse_float(t)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax", arg_names=["data"])
+def _log_softmax(ins, attrs, ctx):
+    axis = parse_int(attrs.get("axis"), -1)
+    return jax.nn.log_softmax(ins[0], axis=axis)
+
+
+@register("SoftmaxActivation", arg_names=["data"])
+def _softmax_activation(ins, attrs, ctx):
+    mode = attrs.get("mode", "instance")
+    x = ins[0]
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+@functools.lru_cache(maxsize=None)
+def _softmax_output_fn(grad_scale, ignore_label, use_ignore, multi_output,
+                       normalization, smooth_alpha):
+    """Build the custom-vjp SoftmaxOutput for one attr combination.
+
+    Reference semantics (``src/operator/softmax_output-inl.h``): forward is
+    softmax over the class axis; backward ignores the incoming out_grad and
+    emits (p - onehot(label)) · grad_scale, normalized per `normalization`.
+    """
+
+    @jax.custom_vjp
+    def f(data, label):
+        return _fwd_only(data)
+
+    def _fwd_only(data):
+        if multi_output:
+            return jax.nn.softmax(data, axis=1)
+        return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1
+                              ).reshape(data.shape)
+
+    def f_fwd(data, label):
+        out = _fwd_only(data)
+        return out, (out, label)
+
+    def f_bwd(res, g):
+        out, label = res
+        if multi_output:
+            # data (N, C, d...) label (N, d...)
+            nclass = out.shape[1]
+            lab = label.astype(jnp.int32)
+            oh = jnp.moveaxis(jax.nn.one_hot(lab, nclass, dtype=out.dtype),
+                              -1, 1)
+            grad = out - oh
+            if smooth_alpha > 0:
+                grad = grad + smooth_alpha / (nclass - 1)
+                grad = grad - jnp.moveaxis(
+                    jax.nn.one_hot(lab, nclass, dtype=out.dtype), -1, 1) * (
+                        smooth_alpha * nclass / (nclass - 1))
+            if use_ignore:
+                m = jnp.expand_dims((lab != int(ignore_label)), 1)
+                grad = grad * m.astype(out.dtype)
+                if normalization == "valid":
+                    denom = jnp.maximum(m.sum().astype(out.dtype), 1.0)
+                    grad = grad / denom
+            if normalization == "batch":
+                grad = grad / out.shape[0]
+            return grad * grad_scale, jnp.zeros_like(label)
+        # standard (N, C) case (label (N,))
+        flat = out.reshape(out.shape[0], -1)
+        nclass = flat.shape[1]
+        lab = label.reshape(-1).astype(jnp.int32)
+        oh = jax.nn.one_hot(lab, nclass, dtype=flat.dtype)
+        grad = flat - oh
+        if smooth_alpha > 0:
+            grad = grad + smooth_alpha / (nclass - 1) \
+                - oh * (smooth_alpha * nclass / (nclass - 1))
+        if use_ignore:
+            m = (lab != int(ignore_label)).astype(flat.dtype)[:, None]
+            grad = grad * m
+            if normalization == "valid":
+                grad = grad / jnp.maximum(m.sum(), 1.0)
+        if normalization == "batch":
+            grad = grad / flat.shape[0]
+        grad = grad * grad_scale
+        return grad.reshape(out.shape), jnp.zeros_like(label)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+@register("SoftmaxOutput", arg_names=["data", "label"], aliases=["Softmax"])
+def _softmax_output(ins, attrs, ctx):
+    fn = _softmax_output_fn(
+        parse_float(attrs.get("grad_scale", 1.0)),
+        parse_float(attrs.get("ignore_label", -1.0)),
+        parse_bool(attrs.get("use_ignore", False)),
+        parse_bool(attrs.get("multi_output", False)),
+        attrs.get("normalization", "null"),
+        parse_float(attrs.get("smooth_alpha", 0.0)))
+    return fn(ins[0], ins[1])
+
+
+def _regression_output(name, fwd, bwd):
+    @functools.lru_cache(maxsize=None)
+    def build(grad_scale):
+        @jax.custom_vjp
+        def f(data, label):
+            return fwd(data)
+
+        def f_fwd(data, label):
+            return fwd(data), (fwd(data), label)
+
+        def f_bwd(res, g):
+            # reference: grad_scale / num_output * (bwd term), no batch
+            # normalization (regression_output-inl.h:88-94)
+            out, label = res
+            n = out.size // out.shape[0] if out.ndim else 1
+            grad = bwd(out, label.reshape(out.shape)) * grad_scale
+            return grad / max(n, 1), jnp.zeros_like(label)
+
+        f.defvjp(f_fwd, f_bwd)
+        return f
+
+    @register(name, arg_names=["data", "label"])
+    def _f(ins, attrs, ctx, _b=build):
+        return _b(parse_float(attrs.get("grad_scale", 1.0)))(ins[0], ins[1])
+    return _f
+
+
+_regression_output("LinearRegressionOutput",
+                   lambda x: x, lambda o, l: o - l)
+_regression_output("MAERegressionOutput",
+                   lambda x: x, lambda o, l: jnp.sign(o - l))
+_regression_output("LogisticRegressionOutput",
+                   jax.nn.sigmoid, lambda o, l: o - l)
+
+
+@register("SVMOutput", arg_names=["data", "label"])
+def _svm_output(ins, attrs, ctx):
+    margin = parse_float(attrs.get("margin", 1.0))
+    reg = parse_float(attrs.get("regularization_coefficient", 1.0))
+    use_linear = parse_bool(attrs.get("use_linear", False))
+
+    @jax.custom_vjp
+    def f(data, label):
+        return data
+
+    def f_fwd(data, label):
+        return data, (data, label)
+
+    def f_bwd(res, g):
+        data, label = res
+        n, c = data.shape
+        lab = label.astype(jnp.int32)
+        oh = jax.nn.one_hot(lab, c, dtype=data.dtype)
+        score_y = jnp.sum(data * oh, axis=1, keepdims=True)
+        violate = (data - score_y + margin > 0).astype(data.dtype) * (1 - oh)
+        if use_linear:
+            grad = reg * (violate - oh * violate.sum(axis=1, keepdims=True))
+        else:
+            m = jnp.maximum(0.0, data - score_y + margin) * (1 - oh)
+            grad = reg * 2 * (m - oh * m.sum(axis=1, keepdims=True))
+        return grad, jnp.zeros_like(label)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(ins[0], ins[1])
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm / InstanceNorm / LayerNorm / LRN
+# ---------------------------------------------------------------------------
+
+def _bn_infer_shape(in_shapes, attrs):
+    data_s = in_shapes[0]
+    axis = parse_int(attrs.get("axis"), 1)
+    if data_s is None:
+        return in_shapes, [None], [in_shapes[3] if len(in_shapes) > 3 else None] * 2
+    c = (data_s[axis],)
+    return [data_s, c, c], [data_s], [c, c]
+
+
+@register("BatchNorm", arg_names=["data", "gamma", "beta"],
+          aux_names=["moving_mean", "moving_var"],
+          infer_shape=_bn_infer_shape, aliases=["BatchNorm_v1"])
+def _batch_norm(ins, attrs, ctx):
+    """Batch normalization (``src/operator/batch_norm-inl.h``).  Reference
+    defaults: eps=1e-3, momentum=0.9, fix_gamma=True.  Aux (moving mean/var)
+    is returned functionally and rebound by the executor."""
+    data, gamma, beta, mov_mean, mov_var = ins
+    eps = parse_float(attrs.get("eps", 1e-3))
+    momentum = parse_float(attrs.get("momentum", 0.9))
+    fix_gamma = parse_bool(attrs.get("fix_gamma", True))
+    use_global = parse_bool(attrs.get("use_global_stats", False))
+    axis = parse_int(attrs.get("axis"), 1)
+
+    red_axes = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = tuple(data.shape[axis] if i == axis else 1
+                   for i in range(data.ndim))
+    if fix_gamma:
+        gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
+    g = gamma.reshape(bshape)
+    b = beta.reshape(bshape)
+
+    if ctx.is_train and not use_global:
+        mean = jnp.mean(data, axis=red_axes)
+        var = jnp.var(data, axis=red_axes)
+        out = (data - mean.reshape(bshape)) * jax.lax.rsqrt(
+            var.reshape(bshape) + eps) * g + b
+        new_mean = mov_mean * momentum + jax.lax.stop_gradient(mean) * (1 - momentum)
+        new_var = mov_var * momentum + jax.lax.stop_gradient(var) * (1 - momentum)
+        return (out,), (new_mean, new_var)
+    out = (data - mov_mean.reshape(bshape)) * jax.lax.rsqrt(
+        mov_var.reshape(bshape) + eps) * g + b
+    return (out,), (mov_mean, mov_var)
+
+
+def _in_infer_shape(in_shapes, attrs):
+    data_s = in_shapes[0]
+    if data_s is None:
+        return in_shapes, [None], []
+    c = (data_s[1],)
+    return [data_s, c, c], [data_s], []
+
+
+@register("InstanceNorm", arg_names=["data", "gamma", "beta"],
+          infer_shape=_in_infer_shape)
+def _instance_norm(ins, attrs, ctx):
+    data, gamma, beta = ins
+    eps = parse_float(attrs.get("eps", 1e-3))
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(bshape) \
+        + beta.reshape(bshape)
+
+
+def _ln_infer_shape(in_shapes, attrs):
+    data_s = in_shapes[0]
+    axis = parse_int(attrs.get("axis"), -1)
+    if data_s is None:
+        return in_shapes, [None], []
+    c = (data_s[axis],)
+    return [data_s, c, c], [data_s], []
+
+
+@register("LayerNorm", arg_names=["data", "gamma", "beta"],
+          infer_shape=_ln_infer_shape)
+def _layer_norm(ins, attrs, ctx):
+    data, gamma, beta = ins
+    eps = parse_float(attrs.get("eps", 1e-5))
+    axis = parse_int(attrs.get("axis"), -1)
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    shp = [1] * data.ndim
+    shp[axis] = data.shape[axis]
+    return (data - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(shp) \
+        + beta.reshape(shp)
+
+
+@register("LRN", arg_names=["data"])
+def _lrn(ins, attrs, ctx):
+    """Local response normalization across channels
+    (``src/operator/lrn-inl.h``)."""
+    x = ins[0]
+    alpha = parse_float(attrs.get("alpha", 1e-4))
+    beta = parse_float(attrs.get("beta", 0.75))
+    knorm = parse_float(attrs.get("knorm", 2.0))
+    nsize = parse_int(attrs.get("nsize"))
+    sq = jnp.square(x)
+    half = nsize // 2
+    sq_pad = jnp.pad(sq, [(0, 0), (half, half)] + [(0, 0)] * (x.ndim - 2))
+    win = sum(sq_pad[:, i:i + x.shape[1]] for i in range(nsize))
+    return x / jnp.power(knorm + alpha / nsize * win, beta)
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+def _pool_infer_shape(in_shapes, attrs):
+    data_s = in_shapes[0]
+    if data_s is None:
+        return in_shapes, [None], []
+    nd = len(data_s) - 2
+    if parse_bool(attrs.get("global_pool", False)):
+        return [data_s], [tuple(data_s[:2]) + (1,) * nd], []
+    kernel = parse_tuple(attrs.get("kernel"), nd)
+    stride = parse_tuple(attrs.get("stride") or (1,) * nd, nd)
+    pad = parse_tuple(attrs.get("pad") or (0,) * nd, nd)
+    conv = attrs.get("pooling_convention", "valid")
+    out_sp = []
+    for i in range(nd):
+        num = data_s[2 + i] + 2 * pad[i] - kernel[i]
+        if conv == "full":
+            o = int(np.ceil(num / stride[i])) + 1
+        else:
+            o = num // stride[i] + 1
+        out_sp.append(o)
+    return [data_s], [tuple(data_s[:2]) + tuple(out_sp)], []
+
+
+@register("Pooling", arg_names=["data"], infer_shape=_pool_infer_shape,
+          aliases=["Pooling_v1"])
+def _pooling(ins, attrs, ctx):
+    """max/avg/sum pooling (``src/operator/pooling-inl.h``) via
+    ``lax.reduce_window``."""
+    x = ins[0]
+    nd = x.ndim - 2
+    ptype = attrs.get("pool_type", "max")
+    if parse_bool(attrs.get("global_pool", False)):
+        red = tuple(range(2, x.ndim))
+        if ptype == "max":
+            return jnp.max(x, axis=red, keepdims=True)
+        if ptype == "sum":
+            return jnp.sum(x, axis=red, keepdims=True)
+        return jnp.mean(x, axis=red, keepdims=True)
+    kernel, stride, pad, _ = _conv_geometry(attrs, nd)
+    conv = attrs.get("pooling_convention", "valid")
+    # output size per convention; 'full' (ceil) needs extra right padding
+    extra = [0] * nd
+    for i in range(nd):
+        num = x.shape[2 + i] + 2 * pad[i] - kernel[i]
+        if conv == "full":
+            o = int(np.ceil(num / stride[i])) + 1
+        else:
+            o = num // stride[i] + 1
+        extra[i] = max(0, (o - 1) * stride[i] + kernel[i]
+                       - (x.shape[2 + i] + 2 * pad[i]))
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = [(0, 0), (0, 0)] + [(pad[i], pad[i] + extra[i]) for i in range(nd)]
+    if ptype == "max":
+        init = -jnp.inf
+        y = jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
+        return y
+    y = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if ptype == "sum":
+        return y
+    # avg: divide by true window size (count includes padding in reference
+    # v0.11 mshadow pool? — reference uses full kernel size divisor)
+    return y / float(np.prod(kernel))
+
+
+@register("UpSampling", arg_names=None, num_outputs=1)
+def _upsampling(ins, attrs, ctx):
+    """nearest/bilinear upsampling (``src/operator/upsampling-inl.h``)."""
+    scale = parse_int(attrs.get("scale"))
+    sample_type = attrs.get("sample_type", "nearest")
+    x = ins[0]
+    if sample_type == "nearest":
+        outs = []
+        for x in ins:
+            y = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+            outs.append(y)
+        if len(outs) > 1:
+            return jnp.concatenate(outs, axis=1)
+        return outs[0]
+    # bilinear via resize (weight input ignored: resize kernel is fixed)
+    x = ins[0]
+    n, c, h, w = x.shape
+    return jax.image.resize(x, (n, c, h * scale, w * scale), "bilinear")
+
+
+# ---------------------------------------------------------------------------
+# Dropout
+# ---------------------------------------------------------------------------
+
+@register("Dropout", arg_names=["data"], needs_rng=True)
+def _dropout(ins, attrs, ctx):
+    """Inverted dropout (``src/operator/dropout-inl.h``): scale by 1/(1-p) at
+    train time, identity at inference."""
+    x = ins[0]
+    p = parse_float(attrs.get("p", 0.5))
+    mode = attrs.get("mode", "training")
+    if (not ctx.is_train and mode != "always") or p <= 0.0 or ctx.rng is None:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(ctx.rng, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+# ---------------------------------------------------------------------------
+# Misc layers
+# ---------------------------------------------------------------------------
+
+@register("Crop", arg_names=None)
+def _crop(ins, attrs, ctx):
+    """Crop to like-shape or explicit h_w (``src/operator/crop-inl.h``)."""
+    x = ins[0]
+    offset = parse_tuple(attrs.get("offset") or (0, 0), 2)
+    h_w = attrs.get("h_w")
+    if len(ins) > 1:
+        th, tw = ins[1].shape[2], ins[1].shape[3]
+    else:
+        th, tw = parse_tuple(h_w, 2)
+    if parse_bool(attrs.get("center_crop", False)):
+        oh = (x.shape[2] - th) // 2
+        ow = (x.shape[3] - tw) // 2
+    else:
+        oh, ow = offset
+    return x[:, :, oh:oh + th, ow:ow + tw]
+
+
+@register("BilinearSampler", arg_names=["data", "grid"])
+def _bilinear_sampler(ins, attrs, ctx):
+    """Bilinear sampling from a flow grid
+    (``src/operator/bilinear_sampler-inl.h``); grid in [-1, 1]."""
+    data, grid = ins
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yi, xi):
+        yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        bidx = jnp.arange(n)[:, None, None]
+        return data[bidx, :, yi, xi]  # (n, oh, ow, c)
+
+    out = (gather(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
+           + gather(y0, x0 + 1) * ((1 - wy) * wx)[..., None]
+           + gather(y0 + 1, x0) * (wy * (1 - wx))[..., None]
+           + gather(y0 + 1, x0 + 1) * (wy * wx)[..., None])
+    return jnp.moveaxis(out, -1, 1)
+
+
+@register("GridGenerator", arg_names=["data"])
+def _grid_generator(ins, attrs, ctx):
+    """affine/warp grid generation (``src/operator/grid_generator-inl.h``)."""
+    transform = attrs.get("transform_type", "affine")
+    data = ins[0]
+    th, tw = parse_tuple(attrs.get("target_shape"), 2)
+    ys = jnp.linspace(-1.0, 1.0, th)
+    xs = jnp.linspace(-1.0, 1.0, tw)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    if transform == "affine":
+        base = jnp.stack([gx.reshape(-1), gy.reshape(-1),
+                          jnp.ones(th * tw)], axis=0)
+        theta = data.reshape(-1, 2, 3)
+        out = jnp.matmul(theta, base)  # (n, 2, th*tw)
+        return out.reshape(-1, 2, th, tw)
+    # warp: data is flow (n, 2, h, w)
+    norm = jnp.stack([gx, gy])[None]
+    flow = data / jnp.asarray([tw / 2.0, th / 2.0]).reshape(1, 2, 1, 1)
+    return norm + flow
+
+
+@register("SpatialTransformer", arg_names=["data", "loc"])
+def _spatial_transformer(ins, attrs, ctx):
+    data, loc = ins
+    th, tw = parse_tuple(attrs.get("target_shape"), 2)
+    grid = _grid_generator([loc], {"transform_type": "affine",
+                                   "target_shape": (th, tw)}, ctx)
+    return _bilinear_sampler([data, grid], {}, ctx)

@@ -1,0 +1,121 @@
+"""Fused optimizer-update ops.
+
+Reference analog: ``src/operator/tensor/optimizer_op.cc`` — sgd_update,
+sgd_mom_update, adam_update, rmsprop_update etc. run *as engine ops* so the
+whole update is one fused kernel.  Here each is one jax-traceable function;
+inside a pjit train step XLA fuses it with the gradient all-reduce epilogue.
+
+All follow the reference update math including ``rescale_grad``,
+``clip_gradient`` and ``wd`` (weight decay applied to the *gradient*).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, parse_float
+
+__all__ = []
+
+
+def _prep_grad(grad, weight, attrs):
+    rescale = parse_float(attrs.get("rescale_grad", 1.0))
+    clip = parse_float(attrs.get("clip_gradient", -1.0))
+    wd = parse_float(attrs.get("wd", 0.0))
+    g = grad * rescale
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g + wd * weight
+
+
+@register("sgd_update", arg_names=["weight", "grad"], mutate_inputs=[0])
+def _sgd_update(ins, attrs, ctx):
+    weight, grad = ins
+    lr = parse_float(attrs.get("lr"))
+    g = _prep_grad(grad, weight, attrs)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", arg_names=["weight", "grad", "mom"],
+          mutate_inputs=[0, 2], num_outputs=2)
+def _sgd_mom_update(ins, attrs, ctx):
+    weight, grad, mom = ins
+    lr = parse_float(attrs.get("lr"))
+    momentum = parse_float(attrs.get("momentum", 0.0))
+    g = _prep_grad(grad, weight, attrs)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("nag_mom_update", arg_names=["weight", "grad", "mom"],
+          mutate_inputs=[0, 2], num_outputs=2)
+def _nag_mom_update(ins, attrs, ctx):
+    weight, grad, mom = ins
+    lr = parse_float(attrs.get("lr"))
+    momentum = parse_float(attrs.get("momentum", 0.0))
+    g = _prep_grad(grad, weight, attrs)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("adam_update", arg_names=["weight", "grad", "mean", "var"],
+          mutate_inputs=[0, 2, 3], num_outputs=3)
+def _adam_update(ins, attrs, ctx):
+    weight, grad, mean, var = ins
+    lr = parse_float(attrs.get("lr"))
+    beta1 = parse_float(attrs.get("beta1", 0.9))
+    beta2 = parse_float(attrs.get("beta2", 0.999))
+    eps = parse_float(attrs.get("epsilon", 1e-8))
+    g = _prep_grad(grad, weight, attrs)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + eps)
+    return new_w, new_mean, new_var
+
+
+@register("rmsprop_update", arg_names=["weight", "grad", "n"],
+          mutate_inputs=[0, 2], num_outputs=2)
+def _rmsprop_update(ins, attrs, ctx):
+    weight, grad, n = ins
+    lr = parse_float(attrs.get("lr"))
+    gamma1 = parse_float(attrs.get("gamma1", 0.95))
+    eps = parse_float(attrs.get("epsilon", 1e-8))
+    g = _prep_grad(grad, weight, attrs)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    return weight - lr * g / jnp.sqrt(new_n + eps), new_n
+
+
+@register("rmspropalex_update",
+          arg_names=["weight", "grad", "n", "g", "delta"],
+          mutate_inputs=[0, 2, 3, 4], num_outputs=4)
+def _rmspropalex_update(ins, attrs, ctx):
+    weight, grad, n, gbar, delta = ins
+    lr = parse_float(attrs.get("lr"))
+    gamma1 = parse_float(attrs.get("gamma1", 0.95))
+    gamma2 = parse_float(attrs.get("gamma2", 0.9))
+    eps = parse_float(attrs.get("epsilon", 1e-8))
+    g = _prep_grad(grad, weight, attrs)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_g = (1 - gamma1) * g + gamma1 * gbar
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(
+        new_n - jnp.square(new_g) + eps)
+    return weight + new_delta, new_n, new_g, new_delta
+
+
+@register("ftrl_update", arg_names=["weight", "grad", "z", "n"],
+          mutate_inputs=[0, 2, 3], num_outputs=3)
+def _ftrl_update(ins, attrs, ctx):
+    weight, grad, z, n = ins
+    lr = parse_float(attrs.get("lr"))
+    lamda1 = parse_float(attrs.get("lamda1", 0.01))
+    beta = parse_float(attrs.get("beta", 1.0))
+    wd = parse_float(attrs.get("wd", 0.0))
+    rescale = parse_float(attrs.get("rescale_grad", 1.0))
+    clip = parse_float(attrs.get("clip_gradient", -1.0))
+    g = grad * rescale
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    new_z = z + g - (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr * weight
+    new_n = n + jnp.square(g)
+    new_w = (jnp.sign(new_z) * lamda1 - new_z) / \
+        ((beta + jnp.sqrt(new_n)) / lr + wd) * (jnp.abs(new_z) > lamda1)
+    return new_w, new_z, new_n

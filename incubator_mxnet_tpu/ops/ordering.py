@@ -1,0 +1,80 @@
+"""Ordering ops: sort / argsort / topk
+(``src/operator/tensor/ordering_op*``, CUB/Thrust kernels in the reference —
+XLA ``sort``/``top_k`` on TPU)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, parse_int, parse_bool
+
+__all__ = []
+
+
+def _axis_of(attrs, default=-1):
+    a = attrs.get("axis", default)
+    if a in (None, "None", ""):
+        return None
+    return parse_int(a)
+
+
+@register("sort", arg_names=["data"])
+def _sort(ins, attrs, ctx):
+    x = ins[0]
+    axis = _axis_of(attrs)
+    is_ascend = parse_bool(attrs.get("is_ascend", True))
+    if axis is None:
+        x = x.reshape(-1)
+        axis = -1
+    out = jnp.sort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", arg_names=["data"])
+def _argsort(ins, attrs, ctx):
+    x = ins[0]
+    axis = _axis_of(attrs)
+    is_ascend = parse_bool(attrs.get("is_ascend", True))
+    if axis is None:
+        x = x.reshape(-1)
+        axis = -1
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.float32)
+
+
+@register("topk", arg_names=["data"],
+          num_outputs=lambda attrs: 2 if attrs.get("ret_typ") == "both" else 1)
+def _topk(ins, attrs, ctx):
+    """top-k along axis; ret_typ in {value, indices, mask, both}
+    (``ordering_op-inl.h`` semantics)."""
+    x = ins[0]
+    axis = _axis_of(attrs)
+    k = parse_int(attrs.get("k"), 1)
+    ret_typ = attrs.get("ret_typ", "indices")
+    is_ascend = parse_bool(attrs.get("is_ascend", False))
+    if axis is None:
+        x = x.reshape(-1)
+        axis = -1
+    ax = axis % x.ndim
+    xs = jnp.moveaxis(x, ax, -1)
+    if is_ascend:
+        vals, idx = jax.lax.top_k(-xs, k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(xs, k)
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax).astype(jnp.float32)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "mask":
+        oh = jax.nn.one_hot(jnp.moveaxis(idx, ax, -1).astype(jnp.int32),
+                            x.shape[ax]).sum(axis=-2)
+        return jnp.moveaxis(oh, -1, ax)
+    # reference kReturnBoth order is (values, indices)
+    return (vals, idx) if ret_typ == "both" else vals

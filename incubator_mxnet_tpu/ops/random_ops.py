@@ -1,0 +1,146 @@
+"""Sampling ops (``src/operator/random/sample_op.{h,cc,cu}`` +
+``multisample_op``): uniform/normal/gamma/exponential/poisson/neg-binomial,
+plus multinomial and shuffle.
+
+TPU-native RNG: ops receive a jax PRNG key via OpContext (the analog of the
+reference's per-device ``ResourceRequest::kRandom`` PRNG seeded by
+``mx.random.seed``, ``src/resource.cc:145``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from .registry import register, parse_tuple, parse_float, parse_int, parse_bool
+
+__all__ = []
+
+
+def _shape_dtype(attrs, default_dtype="float32"):
+    shape = parse_tuple(attrs.get("shape") or (1,))
+    dt = dtype_np(attrs.get("dtype") or default_dtype)
+    return shape, dt
+
+
+@register("_random_uniform", arg_names=[], needs_rng=True,
+          aliases=["uniform", "random_uniform"])
+def _uniform(ins, attrs, ctx):
+    shape, dt = _shape_dtype(attrs)
+    low = parse_float(attrs.get("low", 0.0))
+    high = parse_float(attrs.get("high", 1.0))
+    return jax.random.uniform(ctx.rng, shape, dtype=dt, minval=low,
+                              maxval=high)
+
+
+@register("_random_normal", arg_names=[], needs_rng=True,
+          aliases=["normal", "random_normal"])
+def _normal(ins, attrs, ctx):
+    shape, dt = _shape_dtype(attrs)
+    loc = parse_float(attrs.get("loc", 0.0))
+    scale = parse_float(attrs.get("scale", 1.0))
+    return jax.random.normal(ctx.rng, shape, dtype=dt) * scale + loc
+
+
+@register("_random_gamma", arg_names=[], needs_rng=True,
+          aliases=["random_gamma"])
+def _gamma(ins, attrs, ctx):
+    shape, dt = _shape_dtype(attrs)
+    alpha = parse_float(attrs.get("alpha", 1.0))
+    beta = parse_float(attrs.get("beta", 1.0))
+    return jax.random.gamma(ctx.rng, alpha, shape, dtype=dt) * beta
+
+
+@register("_random_exponential", arg_names=[], needs_rng=True,
+          aliases=["random_exponential"])
+def _exponential(ins, attrs, ctx):
+    shape, dt = _shape_dtype(attrs)
+    lam = parse_float(attrs.get("lam", 1.0))
+    return jax.random.exponential(ctx.rng, shape, dtype=dt) / lam
+
+
+@register("_random_poisson", arg_names=[], needs_rng=True,
+          aliases=["random_poisson"])
+def _poisson(ins, attrs, ctx):
+    shape, dt = _shape_dtype(attrs)
+    lam = parse_float(attrs.get("lam", 1.0))
+    return jax.random.poisson(ctx.rng, lam, shape).astype(dt)
+
+
+@register("_random_negative_binomial", arg_names=[], needs_rng=True,
+          aliases=["random_negative_binomial"])
+def _neg_binomial(ins, attrs, ctx):
+    shape, dt = _shape_dtype(attrs)
+    k = parse_int(attrs.get("k", 1))
+    p = parse_float(attrs.get("p", 1.0))
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    g = jax.random.gamma(ctx.rng, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(jax.random.fold_in(ctx.rng, 1), g, shape
+                              ).astype(dt)
+
+
+@register("_random_generalized_negative_binomial", arg_names=[],
+          needs_rng=True, aliases=["random_generalized_negative_binomial"])
+def _gen_neg_binomial(ins, attrs, ctx):
+    shape, dt = _shape_dtype(attrs)
+    mu = parse_float(attrs.get("mu", 1.0))
+    alpha = parse_float(attrs.get("alpha", 1.0))
+    r = 1.0 / alpha
+    p = mu / (mu + r)
+    g = jax.random.gamma(ctx.rng, r, shape) * (p / (1 - p))
+    return jax.random.poisson(jax.random.fold_in(ctx.rng, 1), g, shape
+                              ).astype(dt)
+
+
+# -- parameterized sampling with per-element distribution params ------------
+
+def _sample_elemwise(name, sampler):
+    @register(name, arg_names=None, needs_rng=True)
+    def _f(ins, attrs, ctx, _s=sampler):
+        shape = attrs.get("shape")
+        shape = parse_tuple(shape) if shape not in (None, "", ()) else ()
+        return _s(ctx.rng, ins, tuple(ins[0].shape) + tuple(shape))
+    return _f
+
+
+_sample_elemwise("sample_uniform",
+                 lambda k, ins, s: ins[0].reshape(ins[0].shape + (1,) * (len(s) - ins[0].ndim))
+                 + jax.random.uniform(k, s) * (ins[1] - ins[0]).reshape(
+                     ins[0].shape + (1,) * (len(s) - ins[0].ndim)))
+_sample_elemwise("sample_normal",
+                 lambda k, ins, s: ins[0].reshape(ins[0].shape + (1,) * (len(s) - ins[0].ndim))
+                 + jax.random.normal(k, s) * ins[1].reshape(
+                     ins[0].shape + (1,) * (len(s) - ins[0].ndim)))
+_sample_elemwise("sample_gamma",
+                 lambda k, ins, s: jax.random.gamma(
+                     k, ins[0].reshape(ins[0].shape + (1,) * (len(s) - ins[0].ndim)), s)
+                 * ins[1].reshape(ins[0].shape + (1,) * (len(s) - ins[0].ndim)))
+_sample_elemwise("sample_exponential",
+                 lambda k, ins, s: jax.random.exponential(k, s)
+                 / ins[0].reshape(ins[0].shape + (1,) * (len(s) - ins[0].ndim)))
+_sample_elemwise("sample_poisson",
+                 lambda k, ins, s: jax.random.poisson(
+                     k, ins[0].reshape(ins[0].shape + (1,) * (len(s) - ins[0].ndim)), s
+                 ).astype(jnp.float32))
+
+
+@register("_sample_multinomial", arg_names=["data"], needs_rng=True,
+          aliases=["sample_multinomial"])
+def _multinomial(ins, attrs, ctx):
+    """Sample class indices from (batched) probability rows
+    (``src/operator/random/multisample_op``)."""
+    p = ins[0]
+    shape = attrs.get("shape")
+    n = 1 if shape in (None, "", ()) else int(parse_tuple(shape)[0])
+    logits = jnp.log(jnp.maximum(p, 1e-37))
+    if p.ndim == 1:
+        out = jax.random.categorical(ctx.rng, logits, shape=(n,))
+        return out.astype(jnp.float32)
+    out = jax.random.categorical(ctx.rng, logits[:, None, :], axis=-1,
+                                 shape=(p.shape[0], n))
+    return (out if n > 1 else out[:, 0]).astype(jnp.float32)
+
+
+@register("_shuffle", arg_names=["data"], needs_rng=True, aliases=["shuffle"])
+def _shuffle(ins, attrs, ctx):
+    return jax.random.permutation(ctx.rng, ins[0], axis=0)

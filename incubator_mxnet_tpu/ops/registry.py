@@ -1,0 +1,174 @@
+"""Single operator registry feeding both frontends.
+
+Reference analog: the NNVM ``Op`` registry with attribute maps
+(``Op::GetAttr<FInferShape>`` etc., SURVEY.md layer 2) + the op attr types in
+``include/mxnet/op_attr_types.h``.  TPU-native redesign: an op is a *pure
+function* over jax arrays; autograd is ``jax.vjp`` of that function, shape
+inference is either an explicit rule (needed for ``simple_bind``-style
+back-inference of parameter shapes) or ``jax.eval_shape`` of the forward.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError, Registry
+
+__all__ = ["OpDef", "OpContext", "register", "get_op", "list_ops", "OPS"]
+
+# case-sensitive: the reference distinguishes e.g. ``softmax`` (op) from
+# ``Softmax`` (SoftmaxOutput alias), ``crop`` (slice alias) from ``Crop``
+OPS = Registry("operator", case_sensitive=True)
+
+
+@dataclasses.dataclass
+class OpContext:
+    """Per-invocation execution context (``OpContext`` at
+    ``include/mxnet/op_attr_types.h:66``): train/test phase and an optional
+    PRNG key for stochastic ops (the reference's ``ResourceRequest::kRandom``
+    per-device PRNG, ``src/resource.cc:84-150``)."""
+
+    is_train: bool = False
+    rng: Any = None  # jax PRNG key, only set when op.needs_rng
+
+
+@dataclasses.dataclass
+class OpDef:
+    """One operator.
+
+    ``fn(inputs, attrs, op_ctx) -> outputs`` where ``inputs`` is a list of
+    jax arrays ordered ``arg_names + aux_names`` and ``outputs`` a tuple of
+    jax arrays; ops with aux state return ``(outputs, new_aux)`` instead.
+    """
+
+    name: str
+    fn: Callable
+    arg_names: Optional[List[str]] = None  # None → variadic (*args like add_n)
+    aux_names: List[str] = dataclasses.field(default_factory=list)
+    num_outputs: int = 1
+    infer_shape: Optional[Callable] = None
+    attr_parser: Optional[Callable[[Dict[str, str]], Dict[str, Any]]] = None
+    needs_rng: bool = False
+    # Reference-visible aliases (e.g. "Flatten" vs "flatten").
+    aliases: List[str] = dataclasses.field(default_factory=list)
+    # Grad of i-th input is accumulated into input (kAddTo-style fused update
+    # ops set this to mutate weights in-place at the NDArray layer).
+    mutate_inputs: List[int] = dataclasses.field(default_factory=list)
+    # Human doc
+    doc: str = ""
+
+    @property
+    def has_aux(self) -> bool:
+        return bool(self.aux_names)
+
+    def get_arg_names(self, attrs: Optional[Dict[str, Any]] = None):
+        """Input names for this op; may depend on attrs (e.g. ``no_bias``
+        removes ``bias``, mirroring ``OperatorProperty::ListArguments``)."""
+        if callable(self.arg_names):
+            return self.arg_names(attrs or {})
+        return self.arg_names
+
+    def get_num_outputs(self, attrs: Optional[Dict[str, Any]] = None) -> int:
+        if callable(self.num_outputs):
+            return self.num_outputs(attrs or {})
+        if self.num_outputs == -1:
+            a = attrs or {}
+            if "num_outputs" in a:
+                return parse_int(a["num_outputs"])
+            return 1
+        return self.num_outputs
+
+    # ---- invocation helpers ---------------------------------------------
+    def apply(self, inputs: Sequence[Any], attrs: Dict[str, Any],
+              op_ctx: Optional[OpContext] = None):
+        """Run forward, normalizing the output to (list_of_outputs, new_aux)."""
+        op_ctx = op_ctx or OpContext()
+        out = self.fn(list(inputs), dict(attrs), op_ctx)
+        if self.has_aux:
+            outs, new_aux = out
+        else:
+            outs, new_aux = out, ()
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        return list(outs), list(new_aux)
+
+
+def register(name: str, *, arg_names=None, aux_names=(), num_outputs=1,
+             infer_shape=None, attr_parser=None, needs_rng=False,
+             aliases=(), mutate_inputs=(), doc=""):
+    """Decorator: register a forward function as an operator under ``name``
+    (and any ``aliases``)."""
+
+    def _wrap(fn):
+        if callable(arg_names):
+            _args = arg_names
+        elif arg_names is not None:
+            _args = list(arg_names)
+        else:
+            _args = None
+        opdef = OpDef(
+            name=name, fn=fn, arg_names=_args,
+            aux_names=list(aux_names), num_outputs=num_outputs,
+            infer_shape=infer_shape, attr_parser=attr_parser,
+            needs_rng=needs_rng, aliases=list(aliases),
+            mutate_inputs=list(mutate_inputs), doc=doc or fn.__doc__ or "")
+        OPS.register(opdef, name=name)
+        for a in opdef.aliases:
+            OPS.register(opdef, name=a)
+        return fn
+
+    return _wrap
+
+
+def get_op(name: str) -> OpDef:
+    op = OPS.find(name)
+    if op is None:
+        raise MXNetError("operator '%s' is not registered" % name)
+    return op
+
+
+def list_ops() -> List[str]:
+    return OPS.keys()
+
+
+# ---------------------------------------------------------------------------
+# attr coercion helpers (dmlc::Parameter-style typed parsing; SURVEY.md §5.6 —
+# the frontend passes op attrs as strings, parsed once at op creation)
+# ---------------------------------------------------------------------------
+
+
+def parse_tuple(v, length=None, typ=int) -> Tuple:
+    """Parse '(2, 2)' / '2' / (2, 2) into a tuple of ``typ``."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        v = v.strip()
+        if v.startswith("(") or v.startswith("["):
+            v = v[1:-1]
+        parts = [p for p in v.replace(",", " ").split() if p]
+        t = tuple(typ(float(p)) if typ is int else typ(p) for p in parts)
+    elif isinstance(v, (tuple, list)):
+        t = tuple(typ(x) for x in v)
+    else:
+        t = (typ(v),)
+    if length is not None and len(t) == 1:
+        t = t * length
+    return t
+
+
+def parse_bool(v) -> bool:
+    if isinstance(v, str):
+        return v.lower() in ("1", "true", "yes")
+    return bool(v)
+
+
+def parse_int(v, default=None):
+    if v is None:
+        return default
+    return int(float(v)) if isinstance(v, str) else int(v)
+
+
+def parse_float(v, default=None):
+    if v is None:
+        return default
+    return float(v)

@@ -1,0 +1,52 @@
+"""Sequence ops (``src/operator/sequence_last/mask/reverse-inl.h``).
+
+Layout follows the reference: time-major ``(seq_len, batch, ...)`` with an
+optional per-batch ``sequence_length`` vector.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, parse_bool, parse_float
+
+__all__ = []
+
+
+@register("SequenceLast", arg_names=["data", "sequence_length"])
+def _seq_last(ins, attrs, ctx):
+    data = ins[0]
+    use_len = parse_bool(attrs.get("use_sequence_length", False))
+    if not use_len or len(ins) < 2 or ins[1] is None:
+        return data[-1]
+    seq_len = ins[1].astype(jnp.int32)
+    idx = jnp.clip(seq_len - 1, 0, data.shape[0] - 1)
+    batch = jnp.arange(data.shape[1])
+    return data[idx, batch]
+
+
+@register("SequenceMask", arg_names=["data", "sequence_length"])
+def _seq_mask(ins, attrs, ctx):
+    data = ins[0]
+    use_len = parse_bool(attrs.get("use_sequence_length", False))
+    value = parse_float(attrs.get("value", 0.0))
+    if not use_len or len(ins) < 2 or ins[1] is None:
+        return data
+    seq_len = ins[1].astype(jnp.int32)
+    t = jnp.arange(data.shape[0])[:, None]
+    mask = t < seq_len[None, :]
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, dtype=data.dtype))
+
+
+@register("SequenceReverse", arg_names=["data", "sequence_length"])
+def _seq_reverse(ins, attrs, ctx):
+    data = ins[0]
+    use_len = parse_bool(attrs.get("use_sequence_length", False))
+    if not use_len or len(ins) < 2 or ins[1] is None:
+        return jnp.flip(data, axis=0)
+    seq_len = ins[1].astype(jnp.int32)
+    T = data.shape[0]
+    t = jnp.arange(T)[:, None]
+    src = jnp.where(t < seq_len[None, :], seq_len[None, :] - 1 - t, t)
+    batch = jnp.arange(data.shape[1])[None, :]
+    return data[src, batch]

@@ -1,0 +1,49 @@
+"""Global PRNG state (``mx.random``).
+
+Reference analog: per-device seeded PRNG resources
+(``ResourceManagerImpl::SeedRandom``, ``src/resource.cc:145``) driven by
+``mx.random.seed``.  TPU-native: a counter-based jax PRNG key chain — every
+stochastic op consumes ``next_key()``, which is ``fold_in(root, counter++)``;
+reseeding resets the chain, giving the reference's reproducibility contract
+(same seed → same sample stream).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "next_key", "current_seed"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _ensure():
+    if not hasattr(_state, "root"):
+        import jax
+
+        _state.seed = _DEFAULT_SEED
+        _state.root = jax.random.PRNGKey(_DEFAULT_SEED)
+        _state.counter = 0
+
+
+def seed(seed_state: int) -> None:
+    """``mx.random.seed(n)`` — reset the global sample stream."""
+    import jax
+
+    _state.seed = int(seed_state)
+    _state.root = jax.random.PRNGKey(int(seed_state))
+    _state.counter = 0
+
+
+def current_seed() -> int:
+    _ensure()
+    return _state.seed
+
+
+def next_key():
+    """Next PRNG key in the stream (consumed by one stochastic op)."""
+    import jax
+
+    _ensure()
+    _state.counter += 1
+    return jax.random.fold_in(_state.root, _state.counter)

@@ -1,0 +1,18 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Mirrors the reference test strategy (SURVEY.md §4): CPU contexts stand in for
+devices; multi-device/multi-"chip" behavior is tested with
+``--xla_force_host_platform_device_count`` the way the reference used
+localhost multi-process ps-lite.
+"""
+import os
+
+# the session env pins JAX_PLATFORMS=axon (the real TPU tunnel); tests run on
+# a virtual multi-device CPU backend instead, so override unconditionally
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+# Deterministic CPU numerics for oracle comparisons
+os.environ.setdefault("TP_ENGINE_TYPE", "ThreadedEnginePerDevice")

@@ -1,0 +1,177 @@
+"""Autograd tests — reference ``tests/python/unittest/test_autograd.py``
+semantics: tape-recorded imperative ops, mark_variables, grad vs analytic."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd as ag
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x + 2 * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy() + 2,
+                               rtol=1e-5)
+
+
+def test_chain_grad():
+    x = mx.nd.array(np.random.rand(3, 4).astype(np.float32) + 0.5)
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.exp(mx.nd.log(x) * 2.0)  # = x^2
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-4)
+
+
+def test_dot_grad():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    b_np = np.random.rand(4, 5).astype(np.float32)
+    a, b = mx.nd.array(a_np), mx.nd.array(b_np)
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        c = mx.nd.dot(a, b)
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(),
+                               np.ones((3, 5)).dot(b_np.T), rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(),
+                               a_np.T.dot(np.ones((3, 5))), rtol=1e-5)
+
+
+def test_head_grad():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3
+    y.backward(mx.nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_grad_add_req():
+    x = mx.nd.array([2.0])
+    g = mx.nd.zeros((1,))
+    ag.mark_variables([x], [g], "add")
+    for _ in range(3):
+        with ag.record():
+            y = x * x
+        y.backward()
+    np.testing.assert_allclose(g.asnumpy(), [12.0])  # 3 * 2x
+
+
+def test_pause_and_modes():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+        with ag.pause():
+            assert not ag.is_recording()
+            z = x * 5  # not recorded
+        y = x * 2
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_mul_constant_branches():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x  # x^3 → 3x^2 = 27
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [27.0], rtol=1e-5)
+
+
+def test_grad_function():
+    x = mx.nd.array([2.0, 3.0])
+    with ag.record():
+        y = mx.nd.sum(x * x)
+    # autograd.grad API (returns grads without attach)
+    gx = ag.grad(y, [x])[0]
+    np.testing.assert_allclose(gx.asnumpy(), 2 * x.asnumpy())
+
+
+def test_softmax_output_loss_grad():
+    # SoftmaxOutput backward = (p - onehot) ignoring out-grad
+    data = mx.nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = mx.nd.array(np.array([0, 1, 2, 3], dtype=np.float32))
+    data.attach_grad()
+    with ag.record():
+        out = mx.nd.SoftmaxOutput(data, label)
+    out.backward()
+    p = out.asnumpy()
+    oh = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+    np.testing.assert_allclose(data.grad.asnumpy(), p - oh, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_dropout_train_vs_predict():
+    x = mx.nd.ones((100, 100))
+    with ag.record(train_mode=True):
+        y = mx.nd.Dropout(x, p=0.5)
+    frac_zero = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    # predict mode: identity
+    y2 = mx.nd.Dropout(x, p=0.5)
+    np.testing.assert_allclose(y2.asnumpy(), x.asnumpy())
+
+
+def test_batchnorm_imperative_aux_update():
+    data = mx.nd.array(np.random.randn(8, 3, 4, 4).astype(np.float32) * 2 + 1)
+    gamma = mx.nd.ones((3,))
+    beta = mx.nd.zeros((3,))
+    mmean = mx.nd.zeros((3,))
+    mvar = mx.nd.ones((3,))
+    with ag.record(train_mode=True):
+        out = mx.nd.BatchNorm(data, gamma, beta, mmean, mvar, fix_gamma=True,
+                              momentum=0.9)
+    # out normalized per channel
+    o = out.asnumpy()
+    assert abs(o.mean()) < 1e-3
+    # aux updated in place: moving_mean moved toward batch mean
+    assert abs(mmean.asnumpy().mean()) > 1e-3
+
+
+def test_second_use_of_input():
+    # diamond: y = a*b where a = x+1, b = x*2 → dy/dx = b + 2a
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        a = x + 1
+        b = x * 2
+        y = a * b
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0 + 6.0])
+
+
+def test_conv_grad_finite_diff():
+    np.random.seed(0)
+    data = np.random.randn(2, 3, 5, 5).astype(np.float32)
+    w = np.random.randn(4, 3, 3, 3).astype(np.float32) * 0.1
+    b = np.zeros(4, dtype=np.float32)
+    d_nd, w_nd, b_nd = mx.nd.array(data), mx.nd.array(w), mx.nd.array(b)
+    for v in (d_nd, w_nd, b_nd):
+        v.attach_grad()
+    with ag.record():
+        out = mx.nd.Convolution(d_nd, w_nd, b_nd, kernel=(3, 3),
+                                num_filter=4, pad=(1, 1))
+        loss = mx.nd.sum(out * out)
+    loss.backward()
+    # finite difference on one weight element
+    eps = 1e-2
+    w2 = w.copy()
+    w2[0, 0, 0, 0] += eps
+    out2 = mx.nd.Convolution(mx.nd.array(data), mx.nd.array(w2),
+                             mx.nd.array(b), kernel=(3, 3), num_filter=4,
+                             pad=(1, 1))
+    l2 = float(mx.nd.sum(out2 * out2).asscalar())
+    w3 = w.copy()
+    w3[0, 0, 0, 0] -= eps
+    out3 = mx.nd.Convolution(mx.nd.array(data), mx.nd.array(w3),
+                             mx.nd.array(b), kernel=(3, 3), num_filter=4,
+                             pad=(1, 1))
+    l3 = float(mx.nd.sum(out3 * out3).asscalar())
+    fd = (l2 - l3) / (2 * eps)
+    np.testing.assert_allclose(w_nd.grad.asnumpy()[0, 0, 0, 0], fd,
+                               rtol=2e-2)

@@ -1,0 +1,245 @@
+"""NDArray tests — ported semantics of reference
+``tests/python/unittest/test_ndarray.py`` (numpy-oracle philosophy,
+SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def test_creation():
+    a = mx.nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    np.testing.assert_allclose(a.asnumpy(), np.zeros((2, 3)))
+
+    b = mx.nd.ones((4,), dtype="int32")
+    assert b.dtype == np.int32
+    assert b.sum().asscalar() == 4
+
+    c = mx.nd.full((2, 2), 7.0)
+    np.testing.assert_allclose(c.asnumpy(), np.full((2, 2), 7.0))
+
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    assert d.dtype == np.float32  # float64 downcast like reference default
+
+    e = mx.nd.arange(0, 10, 2)
+    np.testing.assert_allclose(e.asnumpy(), np.arange(0, 10, 2))
+
+
+def test_elementwise_arith():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    a, b = mx.nd.array(x), mx.nd.array(y)
+
+    np.testing.assert_allclose((a + b).asnumpy(), x + y, rtol=1e-6)
+    np.testing.assert_allclose((a - b).asnumpy(), x - y, rtol=1e-6)
+    np.testing.assert_allclose((a * b).asnumpy(), x * y, rtol=1e-6)
+    np.testing.assert_allclose((a / b).asnumpy(), x / y, rtol=1e-5)
+    np.testing.assert_allclose((a + 1).asnumpy(), x + 1, rtol=1e-6)
+    np.testing.assert_allclose((2 - a).asnumpy(), 2 - x, rtol=1e-6)
+    np.testing.assert_allclose((a * 3).asnumpy(), x * 3, rtol=1e-6)
+    np.testing.assert_allclose((1 / (a + 10)).asnumpy(), 1 / (x + 10),
+                               rtol=1e-5)
+    np.testing.assert_allclose((-a).asnumpy(), -x)
+    np.testing.assert_allclose(abs(a).asnumpy(), np.abs(x))
+    np.testing.assert_allclose((a ** 2).asnumpy(), x ** 2, rtol=1e-5)
+
+
+def test_inplace_ops():
+    x = np.ones((2, 3), dtype=np.float32)
+    a = mx.nd.array(x)
+    a += 2
+    np.testing.assert_allclose(a.asnumpy(), x + 2)
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), (x + 2) * 3)
+
+
+def test_slicing_views_share_storage():
+    # NDArray::Slice/At share storage (include/mxnet/ndarray.h:156-172)
+    a = mx.nd.zeros((4, 3))
+    b = a[1:3]
+    b[:] = 5.0
+    expect = np.zeros((4, 3), dtype=np.float32)
+    expect[1:3] = 5.0
+    np.testing.assert_allclose(a.asnumpy(), expect)
+
+    row = a[0]
+    row[:] = 2.0
+    expect[0] = 2.0
+    np.testing.assert_allclose(a.asnumpy(), expect)
+
+
+def test_reshape_view_shares_storage():
+    a = mx.nd.zeros((2, 6))
+    b = a.reshape((3, 4))
+    b[:] = 1.0
+    np.testing.assert_allclose(a.asnumpy(), np.ones((2, 6)))
+    c = a.reshape((4, -1))
+    assert c.shape == (4, 3)
+
+
+def test_setitem():
+    a = mx.nd.zeros((3, 3))
+    a[1] = 1.0
+    a[2] = np.array([1, 2, 3])
+    out = a.asnumpy()
+    np.testing.assert_allclose(out[1], np.ones(3))
+    np.testing.assert_allclose(out[2], [1, 2, 3])
+
+
+def test_unary_ops_vs_numpy():
+    rng = np.random.RandomState(1)
+    x = (rng.rand(5, 4).astype(np.float32) + 0.1)
+    a = mx.nd.array(x)
+    for name, ref in [("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+                      ("square", np.square), ("tanh", np.tanh),
+                      ("sign", np.sign), ("floor", np.floor),
+                      ("ceil", np.ceil)]:
+        got = getattr(mx.nd, name)(a).asnumpy()
+        np.testing.assert_allclose(got, ref(x), rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+    np.testing.assert_allclose(mx.nd.sigmoid(a).asnumpy(),
+                               1 / (1 + np.exp(-x)), rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.relu(mx.nd.array(x - 0.5)).asnumpy(),
+                               np.maximum(x - 0.5, 0), rtol=1e-6)
+
+
+def test_reductions():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    a = mx.nd.array(x)
+    np.testing.assert_allclose(mx.nd.sum(a).asnumpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.sum(a, axis=1).asnumpy(), x.sum(axis=1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        mx.nd.sum(a, axis=(0, 2), keepdims=True).asnumpy(),
+        x.sum(axis=(0, 2), keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.mean(a, axis=0).asnumpy(), x.mean(0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.max(a, axis=2).asnumpy(), x.max(2))
+    np.testing.assert_allclose(
+        mx.nd.argmax(a, axis=1).asnumpy(), x.argmax(1).astype(np.float32))
+
+
+def test_broadcast_ops():
+    x = np.random.rand(2, 1, 4).astype(np.float32)
+    y = np.random.rand(1, 3, 4).astype(np.float32)
+    a, b = mx.nd.array(x), mx.nd.array(y)
+    np.testing.assert_allclose(mx.nd.broadcast_add(a, b).asnumpy(), x + y,
+                               rtol=1e-6)
+    np.testing.assert_allclose(mx.nd.broadcast_mul(a, b).asnumpy(), x * y,
+                               rtol=1e-6)
+    c = mx.nd.broadcast_to(mx.nd.array(np.ones((1, 4))), shape=(3, 4))
+    assert c.shape == (3, 4)
+
+
+def test_dot():
+    x = np.random.rand(3, 4).astype(np.float32)
+    y = np.random.rand(4, 5).astype(np.float32)
+    out = mx.nd.dot(mx.nd.array(x), mx.nd.array(y)).asnumpy()
+    np.testing.assert_allclose(out, x.dot(y), rtol=1e-5)
+    out_t = mx.nd.dot(mx.nd.array(x), mx.nd.array(y.T),
+                      transpose_b=True).asnumpy()
+    np.testing.assert_allclose(out_t, x.dot(y), rtol=1e-5)
+
+
+def test_shape_ops():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = mx.nd.array(x)
+    np.testing.assert_allclose(mx.nd.transpose(a).asnumpy(), x.T)
+    np.testing.assert_allclose(
+        mx.nd.transpose(a, axes=(1, 0, 2)).asnumpy(), x.transpose(1, 0, 2))
+    np.testing.assert_allclose(mx.nd.Flatten(a).asnumpy(), x.reshape(2, -1))
+    np.testing.assert_allclose(
+        mx.nd.Reshape(a, shape=(4, 6)).asnumpy(), x.reshape(4, 6))
+    np.testing.assert_allclose(
+        mx.nd.expand_dims(a, axis=1).asnumpy(), x[:, None])
+    np.testing.assert_allclose(
+        mx.nd.slice_axis(a, axis=1, begin=1, end=3).asnumpy(), x[:, 1:3])
+    np.testing.assert_allclose(mx.nd.tile(a, reps=(1, 2, 1)).asnumpy(),
+                               np.tile(x, (1, 2, 1)))
+    np.testing.assert_allclose(mx.nd.repeat(a, repeats=2, axis=0).asnumpy(),
+                               np.repeat(x, 2, 0))
+
+
+def test_concat_split():
+    x = np.random.rand(2, 3).astype(np.float32)
+    y = np.random.rand(2, 3).astype(np.float32)
+    out = mx.nd.Concat(mx.nd.array(x), mx.nd.array(y), dim=1)
+    np.testing.assert_allclose(out.asnumpy(), np.concatenate([x, y], 1))
+    parts = mx.nd.SliceChannel(mx.nd.array(x), num_outputs=3, axis=1)
+    assert len(parts) == 3
+    np.testing.assert_allclose(parts[1].asnumpy(), x[:, 1:2])
+
+
+def test_copyto_and_context():
+    a = mx.nd.ones((2, 2), ctx=mx.cpu(0))
+    b = mx.nd.zeros((2, 2), ctx=mx.cpu(1))
+    a.copyto(b)
+    np.testing.assert_allclose(b.asnumpy(), np.ones((2, 2)))
+    c = a.as_in_context(mx.cpu(2))
+    assert c.context == mx.cpu(2) or c.context.device_type == "cpu"
+
+
+def test_astype_cast():
+    a = mx.nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = mx.nd.Cast(a, dtype="float16")
+    assert c.dtype == np.float16
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.params")
+    d = {"w": mx.nd.array(np.random.rand(3, 3).astype(np.float32)),
+         "b": mx.nd.ones((7,))}
+    mx.nd.save(fname, d)
+    back = mx.nd.load(fname)
+    assert set(back) == {"w", "b"}
+    np.testing.assert_allclose(back["w"].asnumpy(), d["w"].asnumpy())
+
+    lst = [mx.nd.ones((2,)), mx.nd.zeros((3,))]
+    mx.nd.save(fname, lst)
+    back = mx.nd.load(fname)
+    assert isinstance(back, list) and len(back) == 2
+
+
+def test_random_reproducibility():
+    mx.random.seed(42)
+    a = mx.nd.random_uniform(shape=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = mx.nd.random_uniform(shape=(5,)).asnumpy()
+    np.testing.assert_allclose(a, b)
+    c = mx.nd.random_normal(loc=1.0, scale=0.0, shape=(4,))
+    np.testing.assert_allclose(c.asnumpy(), np.ones(4), atol=1e-6)
+
+
+def test_indexing_ops():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5], dtype=np.float32)
+    out = mx.nd.Embedding(mx.nd.array(idx), mx.nd.array(w), input_dim=10,
+                          output_dim=4)
+    np.testing.assert_allclose(out.asnumpy(), w[[1, 3, 5]])
+    oh = mx.nd.one_hot(mx.nd.array(idx), depth=10)
+    assert oh.shape == (3, 10)
+    np.testing.assert_allclose(oh.asnumpy().argmax(1), [1, 3, 5])
+
+
+def test_ordering_ops():
+    x = np.random.rand(4, 6).astype(np.float32)
+    a = mx.nd.array(x)
+    np.testing.assert_allclose(mx.nd.sort(a, axis=1).asnumpy(),
+                               np.sort(x, 1), rtol=1e-6)
+    topk = mx.nd.topk(a, axis=1, k=2, ret_typ="value").asnumpy()
+    np.testing.assert_allclose(topk, -np.sort(-x, 1)[:, :2], rtol=1e-6)
+
+
+def test_wait_and_engine():
+    a = mx.nd.ones((100, 100))
+    b = mx.nd.dot(a, a)
+    b.wait_to_read()
+    mx.nd.waitall()
+    assert b.shape == (100, 100)
