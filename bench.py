@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 synthetic-ImageNet training throughput (img/s).
+
+Reference anchor (BASELINE.md): MXNet v0.11 ResNet-50 training, batch 32 —
+181.53 img/s on 1× P100 (``docs/how_to/perf.md:180-188``).  ``vs_baseline``
+is measured img/s divided by that number.
+
+Runs the TPU-native fused train step (forward+backward+SGD in one XLA
+program, bf16 matmuls) on whatever single chip is the default jax backend.
+Prints ONE JSON line.
+
+Env knobs: TP_BENCH_BATCH (default 64), TP_BENCH_STEPS (default 20),
+TP_BENCH_SMALL=1 (tiny shapes for CPU smoke).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 181.53  # P100 ResNet-50 train b32 (docs/how_to/perf.md)
+
+
+def main():
+    small = os.environ.get("TP_BENCH_SMALL") == "1"
+    batch = int(os.environ.get("TP_BENCH_BATCH", "8" if small else "64"))
+    steps = int(os.environ.get("TP_BENCH_STEPS", "3" if small else "20"))
+    image = (3, 32, 32) if small else (3, 224, 224)
+    classes = 10 if small else 1000
+    layers = 18 if small else 50
+
+    import jax
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+
+    net = mx.models.resnet(num_layers=layers, num_classes=classes,
+                           image_shape=image,
+                           dtype="float32" if small else "bfloat16")
+    mesh = parallel.default_mesh(1)
+    step = parallel.FusedTrainStep(
+        net, {"data": (batch,) + image}, {"softmax_label": (batch,)},
+        mesh=mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "wd": 1e-4},
+        initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                          factor_type="in", magnitude=2))
+
+    rng = np.random.RandomState(0)
+    from incubator_mxnet_tpu.parallel.mesh import data_parallel_spec
+
+    # synthetic batch staged on device ONCE (benchmark_score.py pattern);
+    # per-step H2D would measure the host tunnel, not the chip
+    data = jax.device_put(rng.rand(batch, *image).astype(np.float32),
+                          data_parallel_spec(mesh, 1 + len(image)))
+    label = jax.device_put(rng.randint(0, classes, batch)
+                           .astype(np.float32),
+                           data_parallel_spec(mesh, 1))
+    batch_dict = {"data": data, "softmax_label": label}
+
+    # warmup (compile)
+    outs = step(batch_dict)
+    jax.block_until_ready(outs[0])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        outs = step(batch_dict)
+    jax.block_until_ready(outs[0])
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec" if not small
+                  else "resnet18_cifar_train_imgs_per_sec",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,8 @@ for _name, _aliases in _OPTIONAL:
 
 if "symbol" in globals():
     Symbol = symbol.Symbol  # noqa: F821
+if "initializer" in globals():
+    init = initializer.init  # noqa: F821  (mx.init.Xavier() style)
 if "attribute" in globals():
     AttrScope = attribute.AttrScope  # noqa: F821
 if "optimizer" in globals():

@@ -1,0 +1,50 @@
+"""Attribute scoping (``python/mxnet/attribute.py``).
+
+``with mx.AttrScope(ctx_group='dev1'):`` attaches attrs (notably
+``ctx_group`` for the group2ctx model-parallel mechanism,
+SURVEY.md §2.4) to every symbol created in scope.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+_state = threading.local()
+
+
+class AttrScope:
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("AttrScope attr values must be str")
+        self._attr = kwargs
+        self._old = None
+
+    def get(self, attr):
+        """Merge scope attrs into user attrs (user wins)."""
+        if not self._attr:
+            return attr or {}
+        ret = dict(self._attr)
+        if attr:
+            ret.update(attr)
+        return ret
+
+    def __enter__(self):
+        if not hasattr(_state, "stack"):
+            _state.stack = [AttrScope()]
+        merged = dict(_state.stack[-1]._attr)
+        merged.update(self._attr)
+        scope = AttrScope.__new__(AttrScope)
+        scope._attr = merged
+        _state.stack.append(scope)
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+
+
+def current() -> AttrScope:
+    if not hasattr(_state, "stack"):
+        _state.stack = [AttrScope()]
+    return _state.stack[-1]

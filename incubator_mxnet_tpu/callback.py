@@ -1,0 +1,97 @@
+"""Training callbacks (``python/mxnet/callback.py``): Speedometer,
+do_checkpoint, module_checkpoint, ProgressBar, LogValidationMetricsCallback."""
+from __future__ import annotations
+
+import logging
+import math
+import time
+
+__all__ = ["Speedometer", "do_checkpoint", "module_checkpoint",
+           "ProgressBar", "LogValidationMetricsCallback"]
+
+
+class Speedometer:
+    """Log samples/sec every `frequent` batches (reference
+    ``callback.py`` Speedometer)."""
+
+    def __init__(self, batch_size: int, frequent: int = 50,
+                 auto_reset: bool = True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+        self.auto_reset = auto_reset
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / \
+                    (time.time() - self.tic)
+                if param.eval_metric is not None:
+                    name_value = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = "	".join("%s=%f" % nv for nv in name_value)
+                    logging.info(
+                        "Epoch[%d] Batch [%d]	Speed: %.2f samples/sec	%s",
+                        param.epoch, count, speed, msg)
+                else:
+                    logging.info(
+                        "Iter[%d] Batch [%d]	Speed: %.2f samples/sec",
+                        param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+def do_checkpoint(prefix: str, period: int = 1):
+    """Epoch checkpoint callback (reference ``callback.py:55``)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym, arg, aux):
+        from .model import save_checkpoint
+
+        if (iter_no + 1) % period == 0:
+            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+
+    return _callback
+
+
+def module_checkpoint(mod, prefix: str, period: int = 1,
+                      save_optimizer_states: bool = False):
+    """Module-level checkpoint callback (reference ``callback.py:27``)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+
+    return _callback
+
+
+class ProgressBar:
+    def __init__(self, total: int, length: int = 80):
+        self.bar_len = length
+        self.total = total
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled_len = int(round(self.bar_len * count / float(self.total)))
+        percents = math.ceil(100.0 * count / float(self.total))
+        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
+        logging.info("[%s] %s%s", prog_bar, percents, "%")
+
+
+class LogValidationMetricsCallback:
+    def __call__(self, param):
+        if not param.eval_metric:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                         value)

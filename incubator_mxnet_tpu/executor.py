@@ -1,0 +1,373 @@
+"""Executor — the bound, compiled form of a Symbol.
+
+Reference analog: ``GraphExecutor`` (``src/executor/graph_executor.cc``) via
+``Executor::Bind/SimpleBind`` + the python wrapper ``python/mxnet/executor.py``.
+
+TPU-native redesign (SURVEY.md §7): instead of NNVM passes + cached engine
+ops, binding lowers the symbol DAG to a jax function and compiles it with
+``jax.jit``:
+
+- PlanMemory / inplace / bulk-exec segments → XLA buffer assignment + fusion;
+- the Gradient pass → ``jax.vjp`` over the lowered function;
+- forward(is_train=True) is *deferred*: ``backward()`` runs ONE fused
+  fwd+bwd XLA program (outputs + input grads + updated aux in a single
+  compiled call), which is how the reference's dataflow engine overlapped
+  forward/backward and how TPU utilization is kept high.  Accessing
+  ``outputs`` before backward falls back to a forward-only program.
+- BatchNorm-style aux states are functional outputs rebound after each run
+  (the reference mutated aux NDArrays in place).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import random as _random
+from .base import MXNetError, dtype_np
+from .context import Context, current_context
+from .ndarray.ndarray import NDArray
+from .ops.registry import OpContext
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx: Context, arg_dict, grad_dict,
+                 grad_req: Dict[str, str], aux_dict, group2ctx=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self.arg_dict: Dict[str, NDArray] = arg_dict
+        self.grad_dict: Dict[str, NDArray] = grad_dict
+        self.aux_dict: Dict[str, NDArray] = aux_dict
+        self._grad_req = grad_req
+        self._group2ctx = group2ctx or {}
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self._monitor_callback = None
+
+        self._fwd_jit = {}   # is_train -> jitted forward
+        self._bwd_jit = None  # combined fwd+bwd
+        self._outputs_cache: Optional[List[NDArray]] = None
+        self._pending_train = False
+        self._aux_written = False
+        self._last_key = None
+
+    # ------------------------------------------------------------ properties
+    @property
+    def arg_arrays(self) -> List[NDArray]:
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self) -> List[Optional[NDArray]]:
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self) -> List[NDArray]:
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def outputs(self) -> List[NDArray]:
+        if self._outputs_cache is None:
+            self._run_forward()
+        return self._outputs_cache
+
+    # ---------------------------------------------------------------- lower
+    def _lowered(self, is_train: bool):
+        """Build the pure jax function over (args, aux, key) once."""
+        from .lowering import lower_symbol
+
+        return lower_symbol(self._symbol, is_train)
+
+    def _get_fwd(self, is_train: bool):
+        if is_train not in self._fwd_jit:
+            import jax
+
+            self._fwd_jit[is_train] = jax.jit(self._lowered(is_train))
+        return self._fwd_jit[is_train]
+
+    def _get_bwd(self):
+        if self._bwd_jit is None:
+            import jax
+
+            core = self._lowered(True)
+            diff_names = [n for n in self._arg_names
+                          if self._grad_req.get(n, "null") != "null"]
+
+            def bwd(arg_vals, aux_vals, key, out_grads):
+                diff = {n: arg_vals[n] for n in diff_names}
+                rest = {n: v for n, v in arg_vals.items()
+                        if n not in diff}
+
+                def f(d):
+                    merged = dict(rest)
+                    merged.update(d)
+                    outs, new_aux = core(merged, aux_vals, key)
+                    return outs, new_aux
+
+                (outs, new_aux), vjp_fn = jax.vjp(f, diff)
+                import jax.numpy as jnp
+
+                ct_outs = [og if og is not None else jnp.ones_like(o)
+                           for og, o in zip(out_grads, outs)]
+                ct_aux = {k: jnp.zeros_like(v) for k, v in new_aux.items()}
+                (grads,) = vjp_fn((ct_outs, ct_aux))
+                return outs, new_aux, grads
+
+            self._bwd_jit = jax.jit(bwd)
+        return self._bwd_jit
+
+    # ----------------------------------------------------------------- run
+    def forward(self, is_train: bool = False, **kwargs):
+        """Copy kwargs into bound buffers, then run — or, for training,
+        DEFER: backward() executes one fused fwd+bwd XLA program (outputs +
+        grads + aux in a single compiled call; no forward recompute).
+        Accessing ``outputs`` before backward falls back to a forward-only
+        program.  Inference runs eagerly and returns the outputs."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown argument %s" % k)
+            self._write_buf(self.arg_dict[k], v)
+        self._outputs_cache = None
+        self._pending_train = is_train
+        self._aux_written = False
+        self._last_key = _random.next_key()
+        if not is_train:
+            self._run_forward()
+            return self.outputs
+        return None
+
+    def _current_vals(self):
+        args = {n: self.arg_dict[n].data for n in self._arg_names}
+        aux = {n: self.aux_dict[n].data for n in self._aux_names}
+        return args, aux
+
+    def _run_forward(self):
+        fwd = self._get_fwd(self._pending_train)
+        args, aux = self._current_vals()
+        key = self._last_key if self._last_key is not None \
+            else _random.next_key()
+        outs, new_aux = fwd(args, aux, key)
+        self._set_outputs(outs)
+        if self._pending_train and not self._aux_written:
+            self._write_aux(new_aux)
+            self._aux_written = True
+        if self._monitor_callback is not None:
+            self._run_monitor()
+
+    def backward(self, out_grads=None, is_train: bool = True) -> None:
+        """Fused fwd+bwd XLA program; fills grad arrays per grad_req."""
+        if out_grads is None:
+            ogs = [None] * len(self._output_names)
+        elif isinstance(out_grads, NDArray):
+            ogs = [out_grads.data]
+        else:
+            ogs = [g.data if isinstance(g, NDArray) else g for g in out_grads]
+        bwd = self._get_bwd()
+        args, aux = self._current_vals()
+        key = self._last_key if self._last_key is not None \
+            else _random.next_key()
+        outs, new_aux, grads = bwd(args, aux, key, ogs)
+        if self._outputs_cache is None:
+            self._set_outputs(outs)
+        # aux updates exactly once per step: skip if a forward-only run
+        # already wrote them (then grads here are unaffected — train-mode
+        # BN uses batch stats, not the moving aux)
+        if not self._aux_written:
+            self._write_aux(new_aux)
+            self._aux_written = True
+        for name, g in grads.items():
+            req = self._grad_req.get(name, "null")
+            tgt = self.grad_dict.get(name)
+            if tgt is None or req == "null":
+                continue
+            if req == "add":
+                tgt._set_data(tgt.data + g)
+            else:
+                tgt._set_data(g.astype(tgt.dtype))
+
+    def _set_outputs(self, outs):
+        self._outputs_cache = [NDArray(o, ctx=self._ctx) for o in outs]
+
+    def _write_aux(self, new_aux):
+        for n, v in new_aux.items():
+            self.aux_dict[n]._set_data(v)
+
+    # ------------------------------------------------------------- utilities
+    def _write_buf(self, target: NDArray, value) -> None:
+        """Copy into a bound buffer, pinned to this executor's device
+        (the reference's CopyFromTo engine op with a cross-device path)."""
+        import jax
+        import jax.numpy as jnp
+
+        if isinstance(value, NDArray):
+            val = value.data
+        elif isinstance(value, jax.Array):
+            val = value
+        else:
+            val = jnp.asarray(np.asarray(value))
+        target._set_data(jax.device_put(val.astype(target.dtype),
+                                        self._ctx.jax_device))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params: bool = False) -> None:
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self._write_buf(self.arg_dict[k], v)
+            elif not allow_extra_params:
+                raise MXNetError("unknown arg param %s" % k)
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self._write_buf(self.aux_dict[k], v)
+            elif not allow_extra_params:
+                raise MXNetError("unknown aux param %s" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """Rebind with new shapes sharing parameter arrays (bucketing
+        support — reference ``Executor::Reshape``)."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for n, s in zip(self._arg_names, arg_shapes):
+            cur = self.arg_dict[n]
+            if tuple(cur.shape) == tuple(s):
+                new_args[n] = cur
+            else:
+                import jax.numpy as jnp
+
+                new_args[n] = NDArray(jnp.zeros(s, dtype=cur.dtype),
+                                      ctx=self._ctx)
+        grad_dict = {}
+        for n, g in self.grad_dict.items():
+            s = arg_shapes[self._arg_names.index(n)]
+            import jax.numpy as jnp
+
+            grad_dict[n] = NDArray(jnp.zeros(s, dtype=g.dtype),
+                                   ctx=self._ctx)
+        return Executor(self._symbol, self._ctx, new_args, grad_dict,
+                        dict(self._grad_req), dict(self.aux_dict))
+
+    def set_monitor_callback(self, callback) -> None:
+        self._monitor_callback = callback
+
+    def _run_monitor(self):
+        """Per-output monitor hook (``graph_executor.cc:1209-1229`` executor
+        monitor; full per-internal coverage via get_internals binding)."""
+        for name, arr in zip(self._output_names, self._outputs_cache):
+            self._monitor_callback(name, arr)
+
+    def debug_str(self) -> str:
+        lines = ["Symbol outputs: %s" % ", ".join(self._output_names)]
+        for n in self._symbol.topo_nodes():
+            kind = "var" if n.is_variable else n.op.name
+            lines.append("  %s %s <- %s" % (kind, n.name,
+                                            [i.name for i, _ in n.inputs]))
+        return "\n".join(lines)
+
+    # ----------------------------------------------------------- construction
+    @staticmethod
+    def _alloc(shape, dtype, ctx: Context) -> NDArray:
+        import jax
+        import jax.numpy as jnp
+
+        return NDArray(jax.device_put(jnp.zeros(shape, dtype=dtype),
+                                      ctx.jax_device), ctx=ctx)
+
+    @classmethod
+    def _simple_bind(cls, symbol, ctx, grad_req, type_dict, group2ctx,
+                     shared_exec, shapes: Dict[str, Sequence[int]]):
+        """``Symbol.simple_bind``: infer all shapes from given input shapes,
+        allocate args/grads/aux (``GraphExecutor::Init`` +
+        ``InitArguments``, graph_executor.cc:787,898)."""
+        ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
+        type_dict = type_dict or {}
+
+        if isinstance(grad_req, str):
+            req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, dict):
+            req = {n: grad_req.get(n, "null") for n in arg_names}
+        else:
+            req = dict(zip(arg_names, grad_req))
+        # data inputs never get grads by default in simple_bind... the
+        # reference gives every arg a grad under 'write'; match that.
+
+        arg_dict, grad_dict, aux_dict = {}, {}, {}
+        for n, s in zip(arg_names, arg_shapes):
+            dt = dtype_np(type_dict.get(n, np.float32))
+            if shared_exec is not None and n in shared_exec.arg_dict and \
+                    tuple(shared_exec.arg_dict[n].shape) == tuple(s):
+                arg_dict[n] = shared_exec.arg_dict[n]
+            else:
+                arg_dict[n] = cls._alloc(s, dt, ctx)
+            if req[n] != "null":
+                if shared_exec is not None and \
+                        n in shared_exec.grad_dict and \
+                        tuple(shared_exec.grad_dict[n].shape) == tuple(s):
+                    grad_dict[n] = shared_exec.grad_dict[n]
+                else:
+                    grad_dict[n] = cls._alloc(s, dt, ctx)
+        for n, s in zip(aux_names, aux_shapes):
+            if shared_exec is not None and n in shared_exec.aux_dict:
+                aux_dict[n] = shared_exec.aux_dict[n]
+            else:
+                aux_dict[n] = cls._alloc(s, np.float32, ctx)
+        return cls(symbol, ctx, arg_dict, grad_dict, req, aux_dict,
+                   group2ctx)
+
+    @classmethod
+    def _bind(cls, symbol, ctx, args, args_grad, grad_req, aux_states,
+              group2ctx, shared_exec):
+        """``Symbol.bind`` with user-provided buffers
+        (``MXExecutorBindEX``)."""
+        from .ndarray import array as nd_array
+
+        ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        def to_nd(v):
+            return v if isinstance(v, NDArray) else nd_array(v, ctx=ctx)
+
+        if args is None:
+            raise MXNetError("bind requires args")
+        if isinstance(args, dict):
+            arg_dict = {n: to_nd(args[n]) for n in arg_names if n in args}
+            missing = [n for n in arg_names if n not in args]
+            if missing:
+                raise MXNetError("bind missing args %s" % missing)
+        else:
+            arg_dict = {n: to_nd(a) for n, a in zip(arg_names, args)}
+
+        if args_grad is None:
+            grad_dict = {}
+        elif isinstance(args_grad, dict):
+            grad_dict = {n: to_nd(g) for n, g in args_grad.items()}
+        else:
+            grad_dict = {n: to_nd(g)
+                         for n, g in zip(arg_names, args_grad)
+                         if g is not None}
+
+        if isinstance(grad_req, str):
+            req = {n: (grad_req if n in grad_dict or args_grad is None
+                       else "null") for n in arg_names}
+            if args_grad is None:
+                req = {n: "null" for n in arg_names}
+        elif isinstance(grad_req, dict):
+            req = {n: grad_req.get(n, "null") for n in arg_names}
+        else:
+            req = dict(zip(arg_names, grad_req))
+
+        if aux_states is None:
+            aux_dict = {}
+            for n in aux_names:
+                raise MXNetError("bind missing aux state %s" % n)
+        elif isinstance(aux_states, dict):
+            aux_dict = {n: to_nd(aux_states[n]) for n in aux_names}
+        else:
+            aux_dict = {n: to_nd(a) for n, a in zip(aux_names, aux_states)}
+        return cls(symbol, ctx, arg_dict, grad_dict, req, aux_dict,
+                   group2ctx)

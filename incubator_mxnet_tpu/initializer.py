@@ -1,0 +1,306 @@
+"""Weight initializers (``python/mxnet/initializer.py``): registry +
+Uniform/Normal/Xavier/MSRAPrelu/Orthogonal/Bilinear/One/Zero/Constant/
+LSTMBias/Mixed/Load, with the name-pattern dispatch the reference uses
+(``_bias`` → zero, ``_gamma`` → one, …)."""
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .base import Registry
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Initializer", "Uniform", "Normal", "Xavier", "MSRAPrelu",
+           "Orthogonal", "Bilinear", "One", "Zero", "Constant", "LSTMBias",
+           "Mixed", "Load", "InitDesc", "register", "create", "init"]
+
+_REG = Registry("initializer")
+register = _REG.register
+
+
+class InitDesc(str):
+    """Parameter name + attrs descriptor (reference ``InitDesc``)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self) -> str:
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr: NDArray) -> None:
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        init_attr = desc.attrs.get("__init__")
+        if init_attr:
+            create(init_attr)._init_weight(desc, arr)
+            return
+        name = str(desc).lower()
+        if name.endswith("upsampling"):
+            self._init_bilinear(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("moving_mean") or name.endswith("running_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_var") or name.endswith("running_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("moving_inv_var"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # hooks
+    def _init_bilinear(self, desc, arr):
+        Bilinear()._init_weight(desc, arr)
+
+    def _init_bias(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, desc, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_zero(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, desc, arr):
+        arr[:] = 1.0
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    def __repr__(self):
+        return "%s(%s)" % (self.__class__.__name__, self._kwargs)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape
+                                   ).astype(np.float32)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        arr[:] = np.random.normal(0, self.sigma, arr.shape
+                                  ).astype(np.float32)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 0.0
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        arr[:] = self.value
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (reference ``initializer.py`` Xavier: rnd_type,
+    factor_type, magnitude)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            fan_in = fan_out = shape[0] if shape else 1
+        else:
+            if len(shape) > 2:
+                hw_scale = float(np.prod(shape[2:]))
+            fan_in = shape[1] * hw_scale
+            fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        else:
+            factor = fan_out
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = np.random.uniform(-scale, scale, shape
+                                       ).astype(np.float32)
+        else:
+            arr[:] = np.random.normal(0, scale, shape).astype(np.float32)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        Initializer.__init__(self, factor_type=factor_type, slope=slope)
+        self.rnd_type = "gaussian"
+        self.factor_type = factor_type
+        self.magnitude = magnitude
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape).astype(np.float32)
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, desc, arr):
+        weight = np.zeros(arr.shape, dtype=np.float32)
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = np.zeros(arr.shape, dtype=np.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = b
+
+    _init_bias = _init_weight
+    _init_default = _init_weight
+
+
+class Mixed:
+    """Pattern-dispatch initializer (reference ``Mixed``)."""
+
+    def __init__(self, patterns, initializers):
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, desc, arr):
+        for prog, i in self.map:
+            if prog.match(str(desc)):
+                i(desc, arr)
+                return
+        raise ValueError("no initializer pattern matches %s" % desc)
+
+
+class Load:
+    """Init from a saved param dict, falling back to default_init
+    (reference ``Load``)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .ndarray import load as nd_load
+
+            param = nd_load(param)
+        self.param = {k.split(":", 1)[-1]: v for k, v in param.items()}
+        self.default_init = default_init
+
+    def __call__(self, name, arr):
+        name = str(name)
+        if name in self.param:
+            arr[:] = self.param[name].asnumpy()
+        elif self.default_init is not None:
+            self.default_init(name, arr)
+        else:
+            raise ValueError("no init for %s" % name)
+
+
+def create(spec) -> Initializer:
+    if isinstance(spec, Initializer):
+        return spec
+    if isinstance(spec, str):
+        if spec.startswith("["):
+            name, kwargs = json.loads(spec)
+            return _REG.get(name)(**kwargs)
+        return _REG.get(spec)()
+    raise ValueError("cannot create initializer from %r" % spec)
+
+
+class _InitNamespace:
+    """``mx.init.Xavier()`` style access."""
+
+    Uniform = Uniform
+    Normal = Normal
+    Xavier = Xavier
+    MSRAPrelu = MSRAPrelu
+    Orthogonal = Orthogonal
+    Bilinear = Bilinear
+    One = One
+    Zero = Zero
+    Constant = Constant
+    LSTMBias = LSTMBias
+    Mixed = Mixed
+    Load = Load
+    Initializer = Initializer
+    InitDesc = InitDesc
+
+
+init = _InitNamespace

@@ -1,0 +1,254 @@
+"""KVStore — the distributed communication facade.
+
+Reference analog: ``include/mxnet/kvstore.h`` + ``src/kvstore/*`` —
+``local`` (CPU-staged reduce), ``device`` (GPU P2P reduce), ``dist_sync`` /
+``dist_async`` / ``dist_device_sync`` (ps-lite parameter server).
+
+TPU-native redesign (SURVEY.md §5.8): the Init/Push/Pull/updater/Barrier API
+is preserved so Module/Trainer port unchanged, but the transport is:
+
+- ``local``: host-side tree reduce (numpy/jax on host devices);
+- ``device``: XLA all-reduce across the in-process device mesh — a single
+  fused ``psum`` per key group replaces CommDevice's P2P gather-scatter
+  (``src/kvstore/comm.h:222``), riding ICI on a real TPU pod;
+- ``dist_*``: multi-process collectives over jax.distributed (DCN between
+  hosts).  The ps-lite scheduler's rendezvous role is played by the JAX
+  coordination service; ``rank``/``num_workers``/``Barrier`` map to
+  process_index/process_count/global sync.  Per SURVEY.md §3.5 sync-mode
+  math: gradients are *summed* across workers then the updater runs once —
+  exactly what a psum all-reduce computes.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .base import MXNetError, get_env
+from .ndarray.ndarray import NDArray
+from .ndarray import zeros as nd_zeros
+
+__all__ = ["KVStore", "create"]
+
+
+def create(name: str = "local") -> "KVStore":
+    """``mx.kv.create`` — factory (``src/kvstore/kvstore.cc:34-57``)."""
+    name = name.lower()
+    if name not in ("local", "local_allreduce_cpu", "local_allreduce_device",
+                    "device", "dist_sync", "dist_async", "dist_device_sync",
+                    "dist"):
+        raise MXNetError("unknown kvstore type %s" % name)
+    if name.startswith("dist"):
+        return DistKVStore(name)
+    return KVStore(name)
+
+
+class KVStore:
+    """Single-process kvstore (types ``local`` and ``device``)."""
+
+    def __init__(self, kv_type: str = "local"):
+        self.type = kv_type
+        self._store: Dict[Any, NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._optimizer = None
+
+    # ------------------------------------------------------------------ api
+    def init(self, key, value) -> None:
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("key %s already initialized" % k)
+            self._store[k] = v[0].copy() if isinstance(v, list) else v.copy()
+
+    def push(self, key, value, priority: int = 0) -> None:
+        """Aggregate (sum) pushed values per key; run updater if set
+        (``KVStoreLocal::Push``, kvstore_local.h:83)."""
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            if not isinstance(vlist, list):
+                vlist = [vlist]
+            merged = self._reduce(vlist)
+            if self._updater is not None:
+                self._updater(_updater_key(k), merged, self._store[k])
+            else:
+                self._store[k]._set_data(merged.data)
+
+    def pull(self, key, out=None, priority: int = 0) -> None:
+        keys, outs = _key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if not isinstance(olist, list):
+                olist = [olist]
+            src = self._store[k]
+            for o in olist:
+                # broadcast to each destination's device
+                o._set_data(_place_like(src, o))
+
+    def row_sparse_pull(self, *a, **k):
+        raise MXNetError("sparse storage is not supported")
+
+    # ------------------------------------------------------------ reduction
+    def _reduce(self, vlist: List[NDArray]) -> NDArray:
+        """Sum a list of per-device gradients.
+
+        ``device`` semantics: arrays may live on different mesh devices; jax
+        resolves cross-device adds via ICI transfers, and inside a jit step
+        the same reduction lowers to one XLA all-reduce.
+        """
+        if len(vlist) == 1:
+            return vlist[0]
+        import jax
+
+        # stage onto the merge device (CommCPU pinned-buffer copy /
+        # CommDevice merge-buffer analog), then tree-sum
+        dev = next(iter(vlist[0].data.devices()))
+        acc = vlist[0].data
+        for v in vlist[1:]:
+            acc = acc + jax.device_put(v.data, dev)
+        return NDArray(acc, ctx=vlist[0]._ctx)
+
+    # ------------------------------------------------------------ optimizer
+    def set_optimizer(self, optimizer) -> None:
+        from .optimizer import get_updater
+
+        self._optimizer = optimizer
+        self._set_updater(get_updater(optimizer))
+
+    def _set_updater(self, updater: Callable) -> None:
+        self._updater = updater
+
+    def save_optimizer_states(self, fname: str) -> None:
+        if self._updater is None:
+            raise MXNetError("no updater/optimizer set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname: str) -> None:
+        if self._updater is None:
+            raise MXNetError("no updater/optimizer set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # ---------------------------------------------------------------- roles
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    def barrier(self) -> None:
+        from .engine import waitall
+
+        waitall()
+
+    def _barrier_before_exit(self):
+        pass
+
+    def __del__(self):
+        pass
+
+
+class DistKVStore(KVStore):
+    """Multi-host kvstore over jax.distributed (``dist_sync`` /
+    ``dist_async`` / ``dist_device_sync``).
+
+    Worker-side semantics mirror ``KVStoreDist`` (kvstore_dist.h): push
+    all-reduces the gradient across processes (sum), every process runs the
+    identical updater on the identical summed gradient — numerically the
+    reference's server-side single update replicated, which the nightly
+    ``dist_sync_kvstore.py`` contract (value == rate·nrepeat·nworker+1)
+    validates.
+    """
+
+    def __init__(self, kv_type: str):
+        super().__init__(kv_type)
+        self._init_distributed()
+
+    def _init_distributed(self):
+        import jax
+
+        self._rank = 0
+        self._size = 1
+        coord = get_env("KVSTORE_COORDINATOR",
+                        os.environ.get("DMLC_PS_ROOT_URI"))
+        if jax.process_count() > 1:
+            self._rank = jax.process_index()
+            self._size = jax.process_count()
+        elif coord:
+            # explicit rendezvous (tools/launch.py analog): env gives
+            # coordinator address + process rank/count
+            n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+            r = int(os.environ.get("TP_PROCESS_ID", "0"))
+            port = os.environ.get("DMLC_PS_ROOT_PORT", "9876")
+            if n > 1:
+                jax.distributed.initialize(
+                    coordinator_address="%s:%s" % (coord, port),
+                    num_processes=n, process_id=r)
+                self._rank = jax.process_index()
+                self._size = jax.process_count()
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def num_workers(self) -> int:
+        return self._size
+
+    def _allreduce(self, arr: NDArray) -> NDArray:
+        if self._size == 1:
+            return arr
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.multihost_utils import (
+            process_allgather)
+
+        summed = process_allgather(arr.data).sum(axis=0)
+        return NDArray(jnp.asarray(summed), ctx=arr._ctx)
+
+    def push(self, key, value, priority: int = 0) -> None:
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            if not isinstance(vlist, list):
+                vlist = [vlist]
+            merged = self._reduce(vlist)          # intra-process devices
+            merged = self._allreduce(merged)      # inter-process DCN
+            if self._updater is not None:
+                self._updater(_updater_key(k), merged, self._store[k])
+            else:
+                self._store[k]._set_data(merged.data)
+
+    def barrier(self) -> None:
+        super().barrier()
+        if self._size > 1:
+            from jax.experimental.multihost_utils import sync_global_devices
+
+            sync_global_devices("kvstore_barrier")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _key_value(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+def _updater_key(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def _place_like(src: NDArray, dst: NDArray):
+    import jax
+
+    return jax.device_put(src.data.astype(dst.dtype),
+                          dst.context.jax_device)

@@ -1,0 +1,48 @@
+"""Symbol → pure-jax-function lowering, shared by the Executor and the
+fused parallel train step (single source of truth for op apply / aux
+write-back / RNG-key folding semantics)."""
+from __future__ import annotations
+
+from .ops.registry import OpContext
+
+__all__ = ["lower_symbol"]
+
+
+def lower_symbol(symbol, is_train: bool):
+    """Lower a Symbol DAG to ``fn(arg_vals, aux_vals, key) ->
+    (outputs, new_aux)``.
+
+    The returned function is jax-traceable: topological interpretation of
+    the node DAG over the op registry, with per-node PRNG keys derived by
+    ``fold_in`` and functional aux-state threading (the reference mutated
+    aux NDArrays in place; here the executor rebinds them).
+    """
+    nodes = symbol.topo_nodes()
+    outputs = symbol._outputs
+    aux_names = set(symbol.list_auxiliary_states())
+
+    def fn(arg_vals, aux_vals, key):
+        import jax
+
+        env = {}
+        new_aux = dict(aux_vals)
+        for ni, node in enumerate(nodes):
+            if node.is_variable:
+                env[(id(node), 0)] = (new_aux[node.name]
+                                      if node.name in aux_names
+                                      else arg_vals[node.name])
+                continue
+            ins = [env[(id(inp), idx)] for inp, idx in node.inputs]
+            rng = jax.random.fold_in(key, ni) if node.op.needs_rng else None
+            outs, naux = node.op.apply(
+                ins, node.attrs, OpContext(is_train=is_train, rng=rng))
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+            if node.op.has_aux:
+                n_args = len(node.op.get_arg_names(node.attrs))
+                for (inp, _), val in zip(node.inputs[n_args:], naux):
+                    if inp.is_variable:
+                        new_aux[inp.name] = val
+        return [env[(id(n), i)] for n, i in outputs], new_aux
+
+    return fn

@@ -1,0 +1,83 @@
+"""Learning-rate schedulers (``python/mxnet/lr_scheduler.py``):
+FactorScheduler / MultiFactorScheduler (+ Poly, used by examples)."""
+from __future__ import annotations
+
+import logging
+import math
+
+__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
+           "PolyScheduler"]
+
+
+class LRScheduler:
+    def __init__(self, base_lr=0.01):
+        self.base_lr = base_lr
+
+    def __call__(self, num_update: int) -> float:
+        raise NotImplementedError
+
+
+class FactorScheduler(LRScheduler):
+    def __init__(self, step: int, factor: float = 1.0, stop_factor_lr=1e-8):
+        super().__init__()
+        if step < 1:
+            raise ValueError("step must be >= 1")
+        if factor > 1.0:
+            raise ValueError("factor must be <= 1")
+        self.step = step
+        self.factor = factor
+        self.stop_factor_lr = stop_factor_lr
+        self.count = 0
+
+    def __call__(self, num_update: int) -> float:
+        while num_update > self.count + self.step:
+            self.count += self.step
+            self.base_lr *= self.factor
+            if self.base_lr < self.stop_factor_lr:
+                self.base_lr = self.stop_factor_lr
+                logging.info("lr hit stop_factor_lr %.3e", self.base_lr)
+            else:
+                logging.info("update %d: lr -> %.3e", num_update,
+                             self.base_lr)
+        return self.base_lr
+
+
+class MultiFactorScheduler(LRScheduler):
+    def __init__(self, step, factor: float = 1.0):
+        super().__init__()
+        assert isinstance(step, list) and len(step) >= 1
+        for i, s in enumerate(step):
+            if i != 0 and step[i] <= step[i - 1]:
+                raise ValueError("step must be increasing")
+            if s < 1:
+                raise ValueError("step must be >= 1")
+        self.step = step
+        self.cur_step_ind = 0
+        self.factor = factor
+        self.count = 0
+
+    def __call__(self, num_update: int) -> float:
+        while self.cur_step_ind <= len(self.step) - 1:
+            if num_update > self.step[self.cur_step_ind]:
+                self.count = self.step[self.cur_step_ind]
+                self.cur_step_ind += 1
+                self.base_lr *= self.factor
+                logging.info("update %d: lr -> %.3e", num_update,
+                             self.base_lr)
+            else:
+                return self.base_lr
+        return self.base_lr
+
+
+class PolyScheduler(LRScheduler):
+    def __init__(self, max_update: int, power: float = 2.0, base_lr=0.01):
+        super().__init__(base_lr)
+        self.max_update = max_update
+        self.power = power
+        self.base_lr_orig = base_lr
+
+    def __call__(self, num_update: int) -> float:
+        if num_update <= self.max_update:
+            self.base_lr = self.base_lr_orig * math.pow(
+                1.0 - float(num_update) / self.max_update, self.power)
+        return self.base_lr

@@ -1,0 +1,353 @@
+"""Evaluation metrics (``python/mxnet/metric.py``, 1132 LoC): registry of
+EvalMetric — Accuracy, TopK, F1, Perplexity, MAE/MSE/RMSE, CrossEntropy,
+NegativeLogLikelihood, Torch/Caffe (numeric pass-through), CustomMetric,
+CompositeEvalMetric."""
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError, Registry
+from .ndarray.ndarray import NDArray
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
+           "Caffe", "CustomMetric", "np_metric", "create"]
+
+_REG = Registry("metric")
+
+
+def _as_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+def check_label_shapes(labels, preds, shape: bool = False):
+    ln = len(labels) if not shape else labels.shape
+    pn = len(preds) if not shape else preds.shape
+    if ln != pn:
+        raise MXNetError("label/pred count mismatch: %s vs %s" % (ln, pn))
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def update_dict(self, label_dict, pred_dict):
+        if self.output_names is not None:
+            preds = [pred_dict[n] for n in self.output_names]
+        else:
+            preds = list(pred_dict.values())
+        if self.label_names is not None:
+            labels = [label_dict[n] for n in self.label_names]
+        else:
+            labels = list(label_dict.values())
+        self.update(labels, preds)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return "EvalMetric: %s" % dict(self.get_name_value())
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) if isinstance(m, str) else m
+                        for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric) if isinstance(metric, str)
+                            else metric)
+
+    def get_metric(self, index):
+        return self.metrics[index]
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return names, values
+
+
+@_REG.register(name="acc")
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred, label = _as_np(pred), _as_np(label)
+            if pred.ndim > label.ndim:
+                pred = pred.argmax(axis=self.axis)
+            pred = pred.astype(np.int32).reshape(-1)
+            label = label.astype(np.int32).reshape(-1)
+            check_label_shapes(label, pred, shape=True)
+            self.sum_metric += (pred == label).sum()
+            self.num_inst += len(label)
+
+
+@_REG.register(name="top_k_accuracy")
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.top_k = top_k
+        assert top_k > 1, "use Accuracy for top_k=1"
+        self.name += "_%d" % top_k
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred, label = _as_np(pred), _as_np(label).astype(np.int32)
+            assert pred.ndim == 2
+            idx = np.argsort(pred, axis=1)
+            num = pred.shape[0]
+            for j in range(min(self.top_k, pred.shape[1])):
+                self.sum_metric += (
+                    idx[:, pred.shape[1] - 1 - j].flat ==
+                    label.flat).sum()
+            self.num_inst += num
+
+
+@_REG.register(name="f1")
+class F1(EvalMetric):
+    def __init__(self, name="f1", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred, label = _as_np(pred), _as_np(label).astype(np.int32)
+            pred_label = pred.argmax(axis=1)
+            if len(np.unique(label)) > 2:
+                raise MXNetError("F1 supports binary classification only")
+            tp = ((pred_label == 1) & (label == 1)).sum()
+            fp = ((pred_label == 1) & (label == 0)).sum()
+            fn = ((pred_label == 0) & (label == 1)).sum()
+            precision = tp / (tp + fp) if tp + fp > 0 else 0.0
+            recall = tp / (tp + fn) if tp + fn > 0 else 0.0
+            f1 = (2 * precision * recall / (precision + recall)
+                  if precision + recall > 0 else 0.0)
+            self.sum_metric += f1
+            self.num_inst += 1
+
+
+@_REG.register(name="perplexity")
+class Perplexity(EvalMetric):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 **kwargs):
+        super().__init__(name, **kwargs)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        loss, num = 0.0, 0
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            label = label.reshape(-1).astype(np.int32)
+            pred = pred.reshape(-1, pred.shape[-1])
+            probs = pred[np.arange(label.shape[0]), label]
+            if self.ignore_label is not None:
+                ignore = (label == self.ignore_label)
+                probs = np.where(ignore, 1.0, probs)
+                num -= ignore.sum()
+            loss -= np.sum(np.log(np.maximum(1e-10, probs)))
+            num += label.shape[0]
+        self.sum_metric += loss
+        self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@_REG.register(name="mae")
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if label.ndim == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += np.abs(label - pred.reshape(label.shape)
+                                      ).mean()
+            self.num_inst += 1
+
+
+@_REG.register(name="mse")
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if label.ndim == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += ((label - pred.reshape(label.shape)) ** 2
+                                ).mean()
+            self.num_inst += 1
+
+
+@_REG.register(name="rmse")
+class RMSE(EvalMetric):
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if label.ndim == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += np.sqrt(
+                ((label - pred.reshape(label.shape)) ** 2).mean())
+            self.num_inst += 1
+
+
+@_REG.register(name="ce")
+@_REG.register(name="cross-entropy")
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            label = label.ravel().astype(np.int32)
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[np.arange(label.shape[0]), label]
+            self.sum_metric += (-np.log(prob + self.eps)).sum()
+            self.num_inst += label.shape[0]
+
+
+@_REG.register(name="nll_loss")
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(eps=eps, name=name, **kwargs)
+
+
+@_REG.register(name="pearsonr")
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label).ravel(), _as_np(pred).ravel()
+            self.sum_metric += np.corrcoef(pred, label)[0, 1]
+            self.num_inst += 1
+
+
+@_REG.register(name="loss")
+class Loss(EvalMetric):
+    """Mean of the output itself (for loss-symbol outputs)."""
+
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds):
+        for pred in preds:
+            self.sum_metric += _as_np(pred).sum()
+            self.num_inst += _as_np(pred).size
+
+
+class Torch(Loss):
+    def __init__(self, name="torch", **kwargs):
+        super().__init__(name, **kwargs)
+
+
+class Caffe(Loss):
+    def __init__(self, name="caffe", **kwargs):
+        super().__init__(name, **kwargs)
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 **kwargs):
+        name = name or getattr(feval, "__name__", "custom")
+        super().__init__("custom(%s)" % name, **kwargs)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            reval = self._feval(label, pred)
+            if isinstance(reval, tuple):
+                sum_metric, num_inst = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np_metric(name=None, allow_extra_outputs=False):
+    """Decorator creating a CustomMetric from a numpy feval."""
+
+    def wrapper(feval):
+        return CustomMetric(feval, name=name,
+                            allow_extra_outputs=allow_extra_outputs)
+
+    return wrapper
+
+
+def create(metric, **kwargs) -> EvalMetric:
+    if callable(metric):
+        return CustomMetric(metric, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        c = CompositeEvalMetric()
+        for m in metric:
+            c.add(create(m, **kwargs))
+        return c
+    return _REG.get(metric)(**kwargs)

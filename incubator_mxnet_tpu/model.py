@@ -1,0 +1,122 @@
+"""Model helpers (``python/mxnet/model.py``): checkpoint save/load and the
+kvstore plumbing Module uses (_create_kvstore, _initialize_kvstore,
+_update_params[_on_kvstore])."""
+from __future__ import annotations
+
+import logging
+from collections import namedtuple
+from typing import Dict, List, Optional, Tuple
+
+from . import kvstore as kvs
+from . import symbol as sym_mod
+from .base import MXNetError
+from .ndarray import load as nd_load, save as nd_save
+from .ndarray.ndarray import NDArray
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
+           "_create_kvstore", "_initialize_kvstore",
+           "_update_params_on_kvstore", "_update_params"]
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict,
+                    aux_params: Dict) -> None:
+    """Two-file checkpoint: ``prefix-symbol.json`` + ``prefix-%04d.params``
+    (reference ``model.py:340``; NDArray container format analog of
+    ``src/ndarray/ndarray.cc:668``)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd_save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """Returns (symbol, arg_params, aux_params)
+    (reference ``model.py:370``)."""
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params
+
+
+def _create_kvstore(kvstore, num_device: int, arg_params):
+    """Choose kvstore + whether the optimizer update runs inside it
+    (reference ``model.py`` _create_kvstore)."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(int(np_prod(p.shape))
+                               for p in arg_params.values())
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return kv, update_on_kvstore
+
+
+def np_prod(shape):
+    out = 1
+    for s in shape:
+        out *= s
+    return out
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore: bool) -> None:
+    """kv.init each param; distributed pull of initial weights
+    (reference ``model.py:96``)."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        name = param_names[idx]
+        kvstore.init(name, arg_params[name])
+        if update_on_kvstore:
+            kvstore.pull(name, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
+                              param_names) -> None:
+    """push grad, pull weight per key (reference ``model.py:106``)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        name = param_names[index]
+        kvstore.push(name, grad_list, priority=-index)
+        kvstore.pull(name, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None, param_names=None) -> None:
+    """Aggregate via kvstore (store-only) then run the updater per device
+    (reference ``model.py:118``)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        if kvstore:
+            name = param_names[index]
+            kvstore.push(name, grad_list, priority=-index)
+            kvstore.pull(name, grad_list, priority=-index)
+        for k, p in enumerate(zip(arg_list, grad_list)):
+            w, g = p
+            updater(index * num_device + k, g, w)

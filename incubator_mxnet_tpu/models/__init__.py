@@ -1,0 +1,29 @@
+"""Model symbol zoo.
+
+Reference analog: ``example/image-classification/symbols/`` (lenet, mlp,
+alexnet, vgg, resnet, inception-bn) — the networks behind every BASELINE
+config.  Each ``get_symbol`` returns a ``SoftmaxOutput``-headed Symbol
+exactly like the reference train scripts expect.
+"""
+from .lenet import get_symbol as lenet
+from .mlp import get_symbol as mlp
+from .alexnet import get_symbol as alexnet
+from .resnet import get_symbol as resnet
+from .vgg import get_symbol as vgg
+from .inception_bn import get_symbol as inception_bn
+
+__all__ = ["lenet", "mlp", "alexnet", "resnet", "vgg", "inception_bn",
+           "get_symbol"]
+
+_ZOO = {"lenet": lenet, "mlp": mlp, "alexnet": alexnet, "resnet": resnet,
+        "vgg": vgg, "inception-bn": inception_bn,
+        "inception_bn": inception_bn}
+
+
+def get_symbol(network: str, **kwargs):
+    if network.startswith("resnet"):
+        depth = network[len("resnet"):]
+        if depth.isdigit():
+            kwargs.setdefault("num_layers", int(depth))
+        return resnet(**kwargs)
+    return _ZOO[network](**kwargs)

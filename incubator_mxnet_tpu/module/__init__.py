@@ -1,0 +1,6 @@
+"""``mx.mod`` — Module training API (``python/mxnet/module/``)."""
+from .base_module import BaseModule
+from .executor_group import DataParallelExecutorGroup
+from .module import Module
+
+__all__ = ["BaseModule", "Module", "DataParallelExecutorGroup"]

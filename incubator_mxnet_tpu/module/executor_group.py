@@ -1,0 +1,192 @@
+"""DataParallelExecutorGroup — batch slicing over device contexts.
+
+Reference analog: ``python/mxnet/module/executor_group.py:99`` —
+``decide_slices`` splits each batch across contexts, binds one executor per
+context sharing parameter memory, scatters inputs, gathers outputs.
+
+TPU-native note: this classic per-device-executor path exists for API parity
+and for CPU-context graph-partition tests; the high-throughput path on a TPU
+mesh is the fused pjit step in :mod:`..parallel` (one program, batch sharded
+by ``jax.sharding``), which Module selects automatically when all contexts
+sit on one mesh.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..executor import Executor
+from ..io import DataDesc
+from ..ndarray import zeros as nd_zeros, concatenate as nd_concat
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DataParallelExecutorGroup", "_split_input_slice"]
+
+
+def _split_input_slice(batch_size: int, work_load_list: Sequence[float]):
+    """``executor_manager._split_input_slice``: slice indices per device."""
+    total = sum(work_load_list)
+    batch_num_list = [round(batch_size * w / total)
+                      for w in work_load_list]
+    # fix rounding drift
+    delta = batch_size - sum(batch_num_list)
+    batch_num_list[0] += delta
+    slices = []
+    start = 0
+    for n in batch_num_list:
+        slices.append(slice(start, start + int(n)))
+        start += int(n)
+    return slices
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts: List[Context], workload,
+                 data_shapes, label_shapes, param_names,
+                 for_training: bool, inputs_need_grad: bool = False,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write", state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.logger = logger
+
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+
+        self.data_shapes = [DataDesc(*d) if not isinstance(d, DataDesc)
+                            else d for d in data_shapes]
+        self.label_shapes = [DataDesc(*d) if not isinstance(d, DataDesc)
+                             else d for d in (label_shapes or [])]
+        self.data_names = [d.name for d in self.data_shapes]
+        self.label_names = [d.name for d in self.label_shapes]
+
+        self.batch_size = self.data_shapes[0].shape[0]
+        self.slices = _split_input_slice(self.batch_size, self.workload)
+
+        if isinstance(grad_req, str):
+            self.grad_req = {}
+            for name in self.arg_names:
+                if name in self.param_names:
+                    self.grad_req[name] = ("null" if name in
+                                           self.fixed_param_names or
+                                           not for_training else grad_req)
+                elif name in self.data_names:
+                    self.grad_req[name] = grad_req if inputs_need_grad \
+                        else "null"
+                else:
+                    self.grad_req[name] = "null"
+        else:
+            self.grad_req = dict(grad_req)
+
+        self.execs: List[Executor] = []
+        shared_execs = shared_group.execs if shared_group is not None \
+            else [None] * len(contexts)
+        for i, ctx in enumerate(contexts):
+            shapes = {}
+            n_i = self.slices[i].stop - self.slices[i].start
+            for d in self.data_shapes:
+                shapes[d.name] = (n_i,) + tuple(d.shape[1:])
+            for l in self.label_shapes:
+                shapes[l.name] = (n_i,) + tuple(l.shape[1:])
+            self.execs.append(symbol.simple_bind(
+                ctx=ctx, grad_req=self.grad_req,
+                shared_exec=shared_execs[i], **shapes))
+
+        # param arrays shared across calls: [n_params][n_devices]
+        self.param_arrays = [[e.arg_dict[n] for e in self.execs]
+                             for n in self.param_names]
+        self.grad_arrays = [[e.grad_dict.get(n) for e in self.execs]
+                            for n in self.param_names]
+        self.aux_arrays = [[e.aux_dict[n] for e in self.execs]
+                           for n in self.aux_names]
+        self.data_arrays = [[e.arg_dict[n] for e in self.execs]
+                            for n in self.data_names]
+        self.input_grad_arrays = (
+            [[e.grad_dict.get(n) for e in self.execs]
+             for n in self.data_names] if inputs_need_grad else [])
+
+    # ----------------------------------------------------------------- data
+    def _scatter(self, arrays, names):
+        for name, arr in zip(names, arrays):
+            for i, (ex, sl) in enumerate(zip(self.execs, self.slices)):
+                if name in ex.arg_dict:
+                    piece = arr.data[sl] if isinstance(arr, NDArray) \
+                        else np.asarray(arr)[sl]
+                    ex._write_buf(ex.arg_dict[name], piece)
+
+    def forward(self, data_batch, is_train: Optional[bool] = None) -> None:
+        if is_train is None:
+            is_train = self.for_training
+        self._scatter(data_batch.data, self.data_names)
+        if self.label_names and data_batch.label:
+            self._scatter(data_batch.label, self.label_names)
+        for ex in self.execs:
+            ex.forward(is_train=is_train)
+
+    def backward(self, out_grads=None) -> None:
+        if not self.for_training:
+            raise MXNetError("backward on a non-training executor group")
+        for i, (ex, sl) in enumerate(zip(self.execs, self.slices)):
+            og = None
+            if out_grads is not None:
+                og = [g[sl] if isinstance(g, NDArray) else g
+                      for g in out_grads]
+            ex.backward(out_grads=og)
+
+    def get_outputs(self, merge_multi_context: bool = True):
+        outs = [[e.outputs[i] for e in self.execs]
+                for i in range(len(self.output_names))]
+        if merge_multi_context:
+            return [o[0] if len(o) == 1 else nd_concat(o, axis=0)
+                    for o in outs]
+        return outs
+
+    def get_input_grads(self, merge_multi_context: bool = True):
+        if not self.inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True first")
+        grads = self.input_grad_arrays
+        if merge_multi_context:
+            return [g[0] if len(g) == 1 else nd_concat(g, axis=0)
+                    for g in grads]
+        return grads
+
+    # --------------------------------------------------------------- params
+    def set_params(self, arg_params, aux_params,
+                   allow_extra: bool = False) -> None:
+        for ex in self.execs:
+            ex.copy_params_from(arg_params, aux_params,
+                                allow_extra_params=True)
+
+    def get_params(self, arg_params, aux_params) -> None:
+        """Average params across devices into the given dicts
+        (reference semantics: weights are kept in sync, so take dev0 and
+        divide-less copy; aux averaged)."""
+        for name, blocks in zip(self.param_names, self.param_arrays):
+            arg_params[name] = blocks[0].copy()
+        for name, blocks in zip(self.aux_names, self.aux_arrays):
+            if len(blocks) == 1:
+                aux_params[name] = blocks[0].copy()
+            else:
+                acc = blocks[0].copy()
+                for b in blocks[1:]:
+                    acc += b.copyto(acc.context)
+                aux_params[name] = acc / len(blocks)
+
+    def update_metric(self, eval_metric, labels) -> None:
+        for ex, sl in zip(self.execs, self.slices):
+            labels_slice = [l[sl] if isinstance(l, NDArray) else l
+                            for l in labels]
+            eval_metric.update(labels_slice, ex.outputs)
+
+    def install_monitor(self, mon) -> None:
+        for ex in self.execs:
+            mon.install(ex)

@@ -1,0 +1,60 @@
+"""Monitor — per-tensor stats over executor outputs every N batches
+(``python/mxnet/monitor.py`` + executor monitor callback,
+``graph_executor.cc:1209-1229``)."""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, List, Optional
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval: int, stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        if stat_func is None:
+            def stat_func(x):
+                import numpy as np
+
+                return np.abs(x.asnumpy()).mean()
+
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue: List = []
+        self.step = 0
+        self.exes: List = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def stat_helper(self, name, arr):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def install(self, exe) -> None:
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self) -> List:
+        if not self.activated:
+            return []
+        self.activated = False
+        res = self.queue
+        self.queue = []
+        if self.sort:
+            res = sorted(res, key=lambda x: x[1])
+        return res
+
+    def toc_print(self) -> None:
+        for n, k, v in self.toc():
+            logging.info("Batch: %7d %30s %s", n, k, str(v))

@@ -1,0 +1,51 @@
+"""Automatic symbol naming (``python/mxnet/name.py``): thread-local
+``NameManager`` stack assigning ``conv0``, ``conv1``, … when the user gives no
+explicit name, and ``Prefix`` variant for scoped prefixes."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+_state = threading.local()
+
+
+class NameManager:
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint: str):
+        if name:
+            return name
+        hint = hint.lower()
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(_state, "stack"):
+            _state.stack = [NameManager()]
+        self._old = _state.stack[-1]
+        _state.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+
+
+class Prefix(NameManager):
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)
+
+
+def current() -> NameManager:
+    if not hasattr(_state, "stack"):
+        _state.stack = [NameManager()]
+    return _state.stack[-1]

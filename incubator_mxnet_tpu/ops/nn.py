@@ -63,12 +63,12 @@ def _fully_connected(ins, attrs, ctx):
     (num_hidden, in_dim) as in the reference."""
     flatten = parse_bool(attrs.get("flatten", True))
     x = ins[0]
-    w = ins[1]
+    w = ins[1].astype(x.dtype)  # mixed precision: compute in act dtype
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
     y = jnp.matmul(x, w.T)
     if len(ins) > 2:
-        y = y + ins[2]
+        y = y + ins[2].astype(y.dtype)
     return y
 
 
@@ -122,7 +122,7 @@ _CONV_DIMNUMS = {1: ("NCH", "OIH", "NCH"),
 def _convolution(ins, attrs, ctx):
     """N-d convolution (``src/operator/convolution-inl.h:490``); maps to one
     ``lax.conv_general_dilated`` call → MXU."""
-    x, w = ins[0], ins[1]
+    x, w = ins[0], ins[1].astype(ins[0].dtype)  # bf16 policy: act dtype
     nd = x.ndim - 2
     kernel, stride, pad, dilate = _conv_geometry(attrs, nd)
     num_group = parse_int(attrs.get("num_group"), 1)
@@ -133,7 +133,7 @@ def _convolution(ins, attrs, ctx):
         dimension_numbers=_CONV_DIMNUMS[nd],
         feature_group_count=num_group)
     if len(ins) > 2:
-        b = ins[2].reshape((1, -1) + (1,) * nd)
+        b = ins[2].astype(y.dtype).reshape((1, -1) + (1,) * nd)
         y = y + b
     return y
 
@@ -348,7 +348,26 @@ def _softmax_output_fn(grad_scale, ignore_label, use_ignore, multi_output,
     return f
 
 
-@register("SoftmaxOutput", arg_names=["data", "label"], aliases=["Softmax"])
+def _softmax_output_infer_shape(in_shapes, attrs):
+    data_s = in_shapes[0]
+    if data_s is None:
+        return in_shapes, [None], []
+    if parse_bool(attrs.get("multi_output", False)):
+        label_s = (data_s[0],) + tuple(data_s[2:])
+    else:
+        label_s = (data_s[0],)
+    return [data_s, in_shapes[1] or label_s], [data_s], []
+
+
+def _same_as_data_label_infer(in_shapes, attrs):
+    data_s = in_shapes[0]
+    if data_s is None:
+        return in_shapes, [None], []
+    return [data_s, in_shapes[1] or data_s], [data_s], []
+
+
+@register("SoftmaxOutput", arg_names=["data", "label"], aliases=["Softmax"],
+          infer_shape=_softmax_output_infer_shape)
 def _softmax_output(ins, attrs, ctx):
     fn = _softmax_output_fn(
         parse_float(attrs.get("grad_scale", 1.0)),
@@ -381,7 +400,8 @@ def _regression_output(name, fwd, bwd):
         f.defvjp(f_fwd, f_bwd)
         return f
 
-    @register(name, arg_names=["data", "label"])
+    @register(name, arg_names=["data", "label"],
+              infer_shape=_same_as_data_label_infer)
     def _f(ins, attrs, ctx, _b=build):
         return _b(parse_float(attrs.get("grad_scale", 1.0)))(ins[0], ins[1])
     return _f
@@ -395,7 +415,8 @@ _regression_output("LogisticRegressionOutput",
                    jax.nn.sigmoid, lambda o, l: o - l)
 
 
-@register("SVMOutput", arg_names=["data", "label"])
+@register("SVMOutput", arg_names=["data", "label"],
+          infer_shape=_softmax_output_infer_shape)
 def _svm_output(ins, attrs, ctx):
     margin = parse_float(attrs.get("margin", 1.0))
     reg = parse_float(attrs.get("regularization_coefficient", 1.0))
@@ -453,25 +474,29 @@ def _batch_norm(ins, attrs, ctx):
     use_global = parse_bool(attrs.get("use_global_stats", False))
     axis = parse_int(attrs.get("axis"), 1)
 
+    # mixed precision: statistics in f32, output cast back to input dtype
+    in_dtype = data.dtype
+    x32 = data.astype(jnp.float32)
     red_axes = tuple(i for i in range(data.ndim) if i != axis)
     bshape = tuple(data.shape[axis] if i == axis else 1
                    for i in range(data.ndim))
     if fix_gamma:
         gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
-    g = gamma.reshape(bshape)
-    b = beta.reshape(bshape)
+    g = gamma.astype(jnp.float32).reshape(bshape)
+    b = beta.astype(jnp.float32).reshape(bshape)
 
     if ctx.is_train and not use_global:
-        mean = jnp.mean(data, axis=red_axes)
-        var = jnp.var(data, axis=red_axes)
-        out = (data - mean.reshape(bshape)) * jax.lax.rsqrt(
+        mean = jnp.mean(x32, axis=red_axes)
+        var = jnp.var(x32, axis=red_axes)
+        out = (x32 - mean.reshape(bshape)) * jax.lax.rsqrt(
             var.reshape(bshape) + eps) * g + b
         new_mean = mov_mean * momentum + jax.lax.stop_gradient(mean) * (1 - momentum)
         new_var = mov_var * momentum + jax.lax.stop_gradient(var) * (1 - momentum)
-        return (out,), (new_mean, new_var)
-    out = (data - mov_mean.reshape(bshape)) * jax.lax.rsqrt(
-        mov_var.reshape(bshape) + eps) * g + b
-    return (out,), (mov_mean, mov_var)
+        return (out.astype(in_dtype),), (new_mean, new_var)
+    out = (x32 - mov_mean.astype(jnp.float32).reshape(bshape)) * \
+        jax.lax.rsqrt(mov_var.astype(jnp.float32).reshape(bshape) + eps) \
+        * g + b
+    return (out.astype(in_dtype),), (mov_mean, mov_var)
 
 
 def _in_infer_shape(in_shapes, attrs):

@@ -171,4 +171,15 @@ def parse_int(v, default=None):
 def parse_float(v, default=None):
     if v is None:
         return default
-    return float(v)
+    if isinstance(v, (str, int, float)):
+        return float(v)
+    try:
+        import numpy as _np
+
+        if isinstance(v, _np.generic):
+            return float(v)
+    except ImportError:
+        pass
+    # traced jax scalar (e.g. dynamic learning rate inside a jit step):
+    # pass through — jnp arithmetic broadcasts it
+    return v

@@ -1,0 +1,499 @@
+"""Optimizers (``python/mxnet/optimizer.py``, 992 LoC; 13 optimizers).
+
+Each step dispatches to a fused update op from
+``ops/optimizer_ops.py`` (the reference runs sgd_update/adam_update/… as
+single engine ops, ``src/operator/tensor/optimizer_op.cc``) so inside a jit
+train step XLA fuses the whole update.  The ``Updater`` closure is the
+kvstore-side entry exactly as in the reference (``optimizer.py:940``).
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .base import Registry
+from .ndarray import op_invoke, zeros
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "DCASGD", "NAG", "SGLD", "ccSGD", "Adam",
+           "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam",
+           "Test", "Updater", "get_updater", "create", "register"]
+
+_REG = Registry("optimizer")
+
+
+def register(klass):
+    _REG.register(klass)
+    return klass
+
+
+def create(name, **kwargs) -> "Optimizer":
+    return _REG.get(name)(**kwargs)
+
+
+class Optimizer:
+    """Base optimizer: lr/wd multipliers, gradient rescale/clip, per-index
+    update counts (reference ``Optimizer`` base)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.clip_gradient = clip_gradient
+        self.idx2name = dict(param_idx2name or {})
+        self.sym = sym
+
+    create_optimizer = staticmethod(create)
+
+    # -- multipliers -------------------------------------------------------
+    def set_lr_mult(self, args_lr_mult: Dict[str, float]):
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult: Dict[str, float]):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            # reference: no wd on bias/gamma/beta by default
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    # -- bookkeeping -------------------------------------------------------
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    def _get_lr(self, index) -> float:
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler \
+            else self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index) -> float:
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- API ---------------------------------------------------------------
+    def create_state(self, index, weight: NDArray):
+        return None
+
+    def update(self, index, weight: NDArray, grad: NDArray, state) -> None:
+        raise NotImplementedError
+
+    def _common_kwargs(self, index):
+        kw = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+              "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + optional multi-precision
+    (reference ``optimizer.py:334``)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.multi_precision = multi_precision
+
+    def create_state(self, index, weight):
+        mom = None
+        w32 = None
+        if self.multi_precision and weight.dtype == np.float16:
+            w32 = weight.astype(np.float32)
+        if self.momentum != 0.0:
+            base = w32 if w32 is not None else weight
+            mom = zeros(base.shape, ctx=base.context, dtype=base.dtype)
+        if w32 is not None:
+            return (mom, w32)
+        return mom
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        w32 = None
+        mom = state
+        if isinstance(state, tuple):
+            mom, w32 = state
+        target = w32 if w32 is not None else weight
+        g = grad.astype(np.float32) if w32 is not None else grad
+        if mom is not None:
+            op_invoke("sgd_mom_update", [target, g, mom],
+                      dict(kw, momentum=self.momentum), out=target)
+        else:
+            op_invoke("sgd_update", [target, g], kw, out=target)
+        if w32 is not None:
+            weight._set_data(target.data.astype(weight.dtype))
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        mom, w32 = (state if isinstance(state, tuple) else (state, None))
+        target = w32 if w32 is not None else weight
+        g = grad.astype(np.float32) if w32 is not None else grad
+        if mom is not None:
+            op_invoke("nag_mom_update", [target, g, mom],
+                      dict(kw, momentum=self.momentum), out=target)
+        else:
+            op_invoke("sgd_update", [target, g], kw, out=target)
+        if w32 is not None:
+            weight._set_data(target.data.astype(weight.dtype))
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = op_invoke("clip", [g], {"a_min": -self.clip_gradient,
+                                        "a_max": self.clip_gradient})
+        from .ndarray import random_normal
+
+        noise = random_normal(loc=0.0, scale=math.sqrt(lr),
+                              shape=weight.shape)
+        weight._set_data((weight - lr / 2 * (g + wd * weight) + noise).data)
+
+
+@register
+class ccSGD(SGD):
+    """Kept for API parity (reference ccSGD ≡ SGD in python at v0.11)."""
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous: Dict[Any, NDArray] = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = op_invoke("clip", [g], {"a_min": -self.clip_gradient,
+                                        "a_max": self.clip_gradient})
+        mom, prev = state
+        comp = g + wd * weight + self.lamda * g * g * (weight - prev)
+        if mom is not None:
+            mom._set_data((self.momentum * mom - lr * comp).data)
+            delta = mom
+        else:
+            delta = -lr * comp
+        prev._set_data(weight.data)
+        weight._set_data((weight + delta).data)
+
+
+@register
+class Adam(Optimizer):
+    """Adam with bias correction (reference ``optimizer.py`` Adam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        kw["lr"] = kw["lr"] * math.sqrt(coef2) / coef1
+        mean, var = state
+        op_invoke("adam_update", [weight, grad, mean, var],
+                  dict(kw, beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon), out=weight)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = op_invoke("clip", [g], {"a_min": -self.clip_gradient,
+                                        "a_max": self.clip_gradient})
+        history = state
+        history._set_data((history + g * g).data)
+        weight._set_data(
+            (weight - lr * (g / op_invoke(
+                "sqrt", [history + self.float_stable_eps]) + wd * weight)
+             ).data)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp (Tieleman) / RMSPropAlex (centered) —
+    reference ``optimizer.py`` RMSProp with ``centered`` flag."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, ctx=weight.context),
+                    zeros(weight.shape, ctx=weight.context),
+                    zeros(weight.shape, ctx=weight.context))
+        return zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if self.centered:
+            n, g, delta = state
+            op_invoke("rmspropalex_update", [weight, grad, n, g, delta],
+                      dict(kw, gamma1=self.gamma1, gamma2=self.gamma2,
+                           epsilon=self.epsilon), out=weight)
+        else:
+            op_invoke("rmsprop_update", [weight, grad, state],
+                      dict(kw, gamma1=self.gamma1, epsilon=self.epsilon),
+                      out=weight)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = op_invoke("clip", [g], {"a_min": -self.clip_gradient,
+                                        "a_max": self.clip_gradient})
+        acc_g, acc_delta = state
+        acc_g._set_data((self.rho * acc_g + (1 - self.rho) * g * g).data)
+        sqrt = lambda x: op_invoke("sqrt", [x])  # noqa: E731
+        cur_delta = (sqrt(acc_delta + self.epsilon)
+                     / sqrt(acc_g + self.epsilon) * g)
+        acc_delta._set_data(
+            (self.rho * acc_delta
+             + (1 - self.rho) * cur_delta * cur_delta).data)
+        weight._set_data((weight - cur_delta - wd * weight).data)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        z, n = state
+        op_invoke("ftrl_update", [weight, grad, z, n],
+                  dict(kw, lamda1=self.lamda1, beta=self.beta), out=weight)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = op_invoke("clip", [g], {"a_min": -self.clip_gradient,
+                                        "a_max": self.clip_gradient})
+        m_t, u_t = state
+        m_t._set_data((self.beta1 * m_t + (1 - self.beta1) * g).data)
+        u_t._set_data(op_invoke("_maximum",
+                                [self.beta2 * u_t, op_invoke("abs", [g])]
+                                ).data)
+        weight._set_data((weight - lr * m_t / u_t).data)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = op_invoke("clip", [g], {"a_min": -self.clip_gradient,
+                                        "a_max": self.clip_gradient})
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t._set_data((self.beta1 * m_t + (1 - self.beta1) * g).data)
+        v_t._set_data((self.beta2 * v_t + (1 - self.beta2) * g * g).data)
+        g_prime = g / (1.0 - self.m_schedule)
+        m_t_prime = m_t / (1.0 - m_schedule_next)
+        v_t_prime = v_t / (1.0 - self.beta2 ** t)
+        m_t_bar = ((1.0 - momentum_t) * g_prime
+                   + momentum_t_1 * m_t_prime)
+        sqrt_v = op_invoke("sqrt", [v_t_prime])
+        weight._set_data((weight - lr * m_t_bar
+                          / (sqrt_v + self.epsilon)).data)
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer (reference ``Test``): w += g * rescale."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data((weight + grad * self.rescale_grad).data)
+
+
+# ---------------------------------------------------------------------------
+# Updater — the kvstore-side closure (reference ``optimizer.py:940``)
+# ---------------------------------------------------------------------------
+
+
+class Updater:
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states: bytes) -> None:
+        def tod(x):
+            if isinstance(x, np.ndarray):
+                from .ndarray import array as nd_array
+
+                return nd_array(x)
+            if isinstance(x, tuple):
+                return tuple(tod(i) for i in x)
+            return x
+
+        self.states = {k: tod(v)
+                       for k, v in pickle.loads(states).items()}
+
+    def get_states(self) -> bytes:
+        def toh(x):
+            if isinstance(x, NDArray):
+                return x.asnumpy()
+            if isinstance(x, tuple):
+                return tuple(toh(i) for i in x)
+            return x
+
+        return pickle.dumps({k: toh(v) for k, v in self.states.items()})
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -1,0 +1,23 @@
+"""Parallelism over the device mesh — the TPU-native distribution layer.
+
+Reference analog: the data-parallel machinery of SURVEY.md §2.4 —
+``DataParallelExecutorGroup`` batch slicing + KVStore gradient aggregation +
+ps-lite multi-node push/pull.  Here the idiomatic path is ONE sharded
+program: ``jax.sharding.Mesh`` + ``pjit`` with XLA collectives riding ICI
+(psum for gradients ≙ CommDevice reduce ≙ dist_sync server aggregation).
+
+Components:
+- :mod:`.mesh` — mesh construction + ``mesh_group`` (the ``group2ctx``
+  analog for model parallelism);
+- :mod:`.collectives` — psum/all_gather/reduce_scatter/ppermute wrappers;
+- :mod:`.fused` — ``FusedTrainStep``: forward+backward+optimizer in one
+  compiled XLA program over an arbitrary (dp, tp) mesh.
+"""
+from .mesh import build_mesh, default_mesh, data_parallel_spec
+from .collectives import (all_reduce, all_gather, reduce_scatter,
+                          ring_permute, barrier_sync)
+from .fused import FusedTrainStep
+
+__all__ = ["build_mesh", "default_mesh", "data_parallel_spec",
+           "all_reduce", "all_gather", "reduce_scatter", "ring_permute",
+           "barrier_sync", "FusedTrainStep"]

@@ -1,0 +1,54 @@
+"""Collective wrappers — the transport the KVStore facade rides.
+
+Reference analog: CommCPU/CommDevice reduce+broadcast (``comm.h``) and
+ps-lite ZPush/ZPull.  TPU-native: ``lax.psum``/``all_gather``/``ppermute``
+under ``shard_map`` — XLA lowers these to ICI collectives; across hosts the
+same ops ride DCN via jax.distributed process groups.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "ring_permute",
+           "barrier_sync"]
+
+
+def all_reduce(x, axis_name: str = "dp"):
+    """Sum across a mesh axis (inside shard_map/pjit tracing)."""
+    import jax
+
+    return jax.lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name: str = "dp", axis: int = 0, tiled: bool = True):
+    import jax
+
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str = "dp", scatter_dimension: int = 0):
+    import jax
+
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=True)
+
+
+def ring_permute(x, axis_name: str, shift: int = 1):
+    """Send shard to the next device on the ring (ring-attention /
+    pipeline building block)."""
+    import jax
+
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def barrier_sync(name: str = "barrier"):
+    """Host-level barrier across processes (ps-lite Barrier analog)."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental.multihost_utils import sync_global_devices
+
+        sync_global_devices(name)

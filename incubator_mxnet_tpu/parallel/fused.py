@@ -1,0 +1,231 @@
+"""FusedTrainStep — forward+backward+optimizer as ONE sharded XLA program.
+
+This is the TPU-native replacement for the reference's per-batch sequence
+{executor forward, executor backward, kvstore push/pull, optimizer update}
+(SURVEY.md §3.1): under ``jax.jit`` over a ``Mesh``, XLA fuses the whole
+step and inserts the gradient all-reduce (psum over the ``dp`` axis) where
+the KVStore push/pull used to be — overlapping it with backward compute the
+way the reference overlapped ps-lite ZPush with backprop via engine
+priorities (``kvstore_dist.h`` negative-key priorities).
+
+Params/optimizer-states/aux live donated on-device; the learning rate is a
+dynamic scalar input so schedules don't retrigger compilation.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError, dtype_np
+from ..ops.registry import OpContext, get_op
+from .mesh import (data_parallel_spec, default_mesh, replicated_spec)
+
+__all__ = ["FusedTrainStep"]
+
+
+# optimizer name → (update op, #states) — ops from ops/optimizer_ops.py
+_FUSED_OPTS = {
+    "sgd": None,  # resolved to sgd_update / sgd_mom_update by momentum
+    "adam": ("adam_update", 2),
+    "rmsprop": ("rmsprop_update", 1),
+    "nag": ("nag_mom_update", 1),
+    "ftrl": ("ftrl_update", 2),
+}
+
+
+from ..lowering import lower_symbol as _lower_symbol  # shared lowering
+
+
+class FusedTrainStep:
+    """One-program data-parallel trainer over a mesh.
+
+    >>> step = FusedTrainStep(net, {'data': (256, 3, 224, 224)},
+    ...                       {'softmax_label': (256,)}, mesh=mesh,
+    ...                       optimizer='sgd',
+    ...                       optimizer_params={'momentum': 0.9})
+    >>> out = step(batch)          # params update in place (donated)
+    """
+
+    def __init__(self, symbol, data_shapes: Dict[str, Sequence[int]],
+                 label_shapes: Optional[Dict[str, Sequence[int]]] = None,
+                 mesh=None, optimizer: str = "sgd",
+                 optimizer_params: Optional[Dict[str, Any]] = None,
+                 initializer=None, dtype=None, seed: int = 0,
+                 param_partition: Optional[Dict[str, Any]] = None):
+        import jax
+        import jax.numpy as jnp
+
+        self.symbol = symbol
+        self.mesh = mesh if mesh is not None else default_mesh()
+        label_shapes = label_shapes or {}
+        shapes = dict(data_shapes)
+        shapes.update(label_shapes)
+        self.input_names = list(shapes.keys())
+
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+        self.param_names = [n for n in arg_names if n not in shapes]
+        shape_of = dict(zip(arg_names, arg_shapes))
+        self.global_batch = shapes[self.input_names[0]][0]
+
+        # ---- optimizer resolution ---------------------------------------
+        opt_params = dict(optimizer_params or {})
+        self.lr = float(opt_params.pop("learning_rate", 0.01))
+        self.lr_scheduler = opt_params.pop("lr_scheduler", None)
+        momentum = float(opt_params.get("momentum", 0.0))
+        if optimizer == "sgd":
+            if momentum != 0.0:
+                self._opt_op, self._n_states = "sgd_mom_update", 1
+            else:
+                self._opt_op, self._n_states = "sgd_update", 0
+                opt_params.pop("momentum", None)
+        elif optimizer in _FUSED_OPTS:
+            self._opt_op, self._n_states = _FUSED_OPTS[optimizer]
+        else:
+            raise MXNetError("FusedTrainStep does not support optimizer %s"
+                             % optimizer)
+        opt_params.setdefault("rescale_grad", 1.0 / self.global_batch)
+        self._opt_attrs = opt_params
+        self.num_update = 0
+
+        # ---- parameter init (host, then shard) --------------------------
+        from ..initializer import InitDesc, Uniform
+        from ..ndarray import zeros as nd_zeros
+
+        initializer = initializer or Uniform(0.01)
+        rep = replicated_spec(self.mesh)
+        cast = dtype_np(dtype) if dtype else None
+        # per-param sharding override: name → PartitionSpec (tensor/model
+        # parallelism — the mesh_group analog of the reference's group2ctx)
+        self._param_sharding = {}
+        for n in self.param_names:
+            spec = (param_partition or {}).get(n)
+            if spec is not None:
+                self._param_sharding[n] = jax.sharding.NamedSharding(
+                    self.mesh, spec)
+            else:
+                self._param_sharding[n] = rep
+
+        def host_init(name, shape):
+            # mixed precision: params stay f32 masters; ops cast to the
+            # activation dtype at use sites (`cast` forces storage dtype
+            # only when explicitly requested)
+            arr = nd_zeros(shape)
+            initializer(InitDesc(name), arr)
+            a = arr.data
+            if cast is not None and name.endswith("weight"):
+                a = a.astype(cast)
+            return jax.device_put(a, self._param_sharding[name])
+
+        self.params = {n: host_init(n, shape_of[n])
+                       for n in self.param_names}
+        self.aux = {n: jax.device_put(
+            jnp.ones(s) if n.endswith(("var",)) else jnp.zeros(s), rep)
+            for n, s in zip(aux_names, aux_shapes)}
+        self.opt_states = {
+            n: tuple(jax.device_put(jnp.zeros_like(self.params[n]),
+                                    self._param_sharding[n])
+                     for _ in range(self._n_states))
+            for n in self.param_names}
+        self._key = jax.random.PRNGKey(seed)
+        self._step_fn = self._build(shapes)
+
+    # -------------------------------------------------------------- build
+    def _build(self, shapes):
+        import jax
+        import jax.numpy as jnp
+
+        fwd = _lower_symbol(self.symbol, is_train=True)
+        opt_op = get_op(self._opt_op)
+        opt_attrs = dict(self._opt_attrs)
+        n_states = self._n_states
+
+        def step(params, opt_states, aux, key, lr, batch):
+            def f(p):
+                args = dict(batch)
+                args.update(p)
+                outs, new_aux = fwd(args, aux, key)
+                return outs, new_aux
+
+            (outs, new_aux), vjp_fn = jax.vjp(f, params)
+            ct = ([jnp.ones_like(o) for o in outs],
+                  {k: jnp.zeros_like(v) for k, v in new_aux.items()})
+            (grads,) = vjp_fn(ct)
+
+            new_params, new_states = {}, {}
+            for name, w in params.items():
+                g = grads[name].astype(w.dtype)
+                attrs = dict(opt_attrs, lr=lr)
+                res, _ = opt_op.apply([w, g] + list(opt_states[name]),
+                                      attrs, OpContext(is_train=True))
+                new_params[name] = res[0]
+                new_states[name] = tuple(res[1:1 + n_states])
+            return new_params, new_states, new_aux, outs
+
+        dp = lambda ndim: data_parallel_spec(self.mesh, ndim)  # noqa: E731
+        rep = replicated_spec(self.mesh)
+
+        batch_shardings = {n: dp(len(s)) for n, s in shapes.items()}
+        param_sh = dict(self._param_sharding)
+        state_sh = {n: tuple(self._param_sharding[n]
+                             for _ in range(n_states))
+                    for n in self.params}
+        aux_sh = {n: rep for n in self.aux}
+
+        return jax.jit(
+            step,
+            in_shardings=(param_sh, state_sh, aux_sh, None, None,
+                          batch_shardings),
+            out_shardings=(param_sh, state_sh, aux_sh, None),
+            donate_argnums=(0, 1, 2))
+
+    # ---------------------------------------------------------------- call
+    def __call__(self, batch: Dict[str, Any]):
+        """Run one step; returns the symbol outputs (sharded on dp)."""
+        import jax
+        import jax.numpy as jnp
+
+        self.num_update += 1
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler \
+            else self.lr
+        self._key = jax.random.fold_in(self._key, 1)
+        vals = {}
+        for n, v in batch.items():
+            from ..ndarray.ndarray import NDArray
+
+            if isinstance(v, NDArray):
+                a = v.data
+            elif isinstance(v, jax.Array):
+                a = v  # already device-resident: no host round-trip
+            else:
+                a = jnp.asarray(np.asarray(v, dtype=np.float32))
+            vals[n] = a
+        self.params, self.opt_states, self.aux, outs = self._step_fn(
+            self.params, self.opt_states, self.aux, self._key,
+            jnp.float32(lr), vals)
+        return outs
+
+    # ------------------------------------------------------------- params
+    def get_params(self):
+        """Gather to host as NDArray dicts (Module-compatible)."""
+        from ..ndarray.ndarray import NDArray
+
+        arg = {n: NDArray(v) for n, v in self.params.items()}
+        aux = {n: NDArray(v) for n, v in self.aux.items()}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params=None):
+        import jax
+
+        rep = replicated_spec(self.mesh)
+        for n, v in (arg_params or {}).items():
+            if n in self.params:
+                data = v.data if hasattr(v, "data") else v
+                self.params[n] = jax.device_put(
+                    data.astype(self.params[n].dtype), rep)
+        for n, v in (aux_params or {}).items():
+            if n in self.aux:
+                data = v.data if hasattr(v, "data") else v
+                self.aux[n] = jax.device_put(data, rep)

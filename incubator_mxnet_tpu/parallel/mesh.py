@@ -1,0 +1,57 @@
+"""Device-mesh construction and sharding specs.
+
+The mesh axes convention follows the scaling-book recipe: ``dp`` (data),
+``tp`` (tensor/model), optional ``pp``/``sp`` added by their stages.  On a
+real pod the mesh maps onto ICI topology (jax orders devices accordingly);
+under tests it is a virtual CPU mesh
+(``--xla_force_host_platform_device_count``).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = ["build_mesh", "default_mesh", "data_parallel_spec",
+           "replicated_spec"]
+
+
+def build_mesh(axes: Dict[str, int], devices=None):
+    """Build a ``jax.sharding.Mesh`` with named axes, e.g.
+    ``build_mesh({'dp': 4, 'tp': 2})``."""
+    import jax
+    import numpy as np
+
+    devices = devices if devices is not None else jax.devices()
+    names = tuple(axes.keys())
+    sizes = tuple(axes.values())
+    n = 1
+    for s in sizes:
+        n *= s
+    if n > len(devices):
+        raise ValueError("mesh needs %d devices, have %d"
+                         % (n, len(devices)))
+    arr = np.array(devices[:n]).reshape(sizes)
+    return jax.sharding.Mesh(arr, names)
+
+
+def default_mesh(n_devices: Optional[int] = None):
+    """1-D data-parallel mesh over all (or n) devices."""
+    import jax
+
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return build_mesh({"dp": n}, devs)
+
+
+def data_parallel_spec(mesh, ndim: int):
+    """NamedSharding: batch axis over 'dp', rest replicated."""
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    spec = P("dp", *([None] * (ndim - 1))) if ndim > 0 else P()
+    return jax.sharding.NamedSharding(mesh, spec)
+
+
+def replicated_spec(mesh):
+    import jax
+
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())

@@ -1,0 +1,507 @@
+"""``mx.sym`` — symbolic graph frontend.
+
+Reference analog: ``nnvm::Symbol`` composition + ``python/mxnet/symbol.py``
+(compose, infer shape/type, save/load JSON, simple_bind).  TPU-native
+redesign: a Symbol is a lightweight DAG over the same op registry the
+imperative frontend uses; *binding* lowers the DAG to one jax function that
+``jax.jit`` compiles — the jit boundary is the analog of the reference's
+bulk-exec segment (``graph_executor.cc:1130``), and XLA replaces the NNVM
+passes (InferShape/Type eagerly here for API parity and error messages;
+PlanMemory/fusion inside XLA).
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import attribute, name as _name_mod
+from ..base import MXNetError, dtype_np, dtype_name
+from ..ops.registry import OPS, OpDef, get_op
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "zeros", "ones"]
+
+
+class _Node:
+    """One graph node (op application or variable)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "_num_outputs")
+
+    def __init__(self, op: Optional[OpDef], name: str,
+                 attrs: Dict[str, Any], inputs: List[Tuple["_Node", int]]):
+        self.op = op
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+        self._num_outputs = 1 if op is None else op.get_num_outputs(attrs)
+
+    @property
+    def is_variable(self) -> bool:
+        return self.op is None
+
+    def output_names(self) -> List[str]:
+        if self.op is None:
+            return [self.name]
+        n = self._num_outputs
+        if n == 1:
+            return ["%s_output" % self.name]
+        return ["%s_output%d" % (self.name, i) for i in range(n)]
+
+    def aux_input_count(self) -> int:
+        return len(self.op.aux_names) if self.op is not None else 0
+
+
+class Symbol:
+    """A set of output entries over the node DAG."""
+
+    def __init__(self, outputs: List[Tuple[_Node, int]]):
+        self._outputs = outputs
+
+    # ------------------------------------------------------------- structure
+    @property
+    def name(self) -> str:
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        names = ", ".join(n.name for n, _ in self._outputs)
+        return "<Symbol %s>" % names
+
+    def __iter__(self):
+        return (Symbol([o]) for o in self._outputs)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            for i, nm in enumerate(self.list_outputs()):
+                if nm == index:
+                    return Symbol([self._outputs[i]])
+            raise MXNetError("no output named %s" % index)
+        return Symbol([self._outputs[index]])
+
+    def topo_nodes(self) -> List[_Node]:
+        """Topological order of all nodes reachable from outputs."""
+        order, seen = [], set()
+
+        def visit(node: _Node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for inp, _ in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for n, _ in self._outputs:
+            visit(n)
+        return order
+
+    def list_arguments(self) -> List[str]:
+        """Leaf variable names in topo order, excluding aux states
+        (``nnvm::Symbol::ListInputNames(kReadOnlyArgs)``) — single O(N)
+        pass."""
+        nodes = self.topo_nodes()
+        aux = self._aux_var_names(nodes)
+        args = []
+        for node in nodes:
+            if node.is_variable and node.name not in aux \
+                    and node.name not in args:
+                args.append(node.name)
+        return args
+
+    def list_auxiliary_states(self) -> List[str]:
+        return list(self._aux_var_names(self.topo_nodes()))
+
+    @staticmethod
+    def _aux_var_names(nodes) -> "dict":
+        """Ordered set of variable names feeding aux-input slots."""
+        aux = {}
+        for node in nodes:
+            if node.op is not None and node.op.has_aux:
+                n_args = len(node.op.get_arg_names(node.attrs))
+                for pos, (inp, _) in enumerate(node.inputs):
+                    if pos >= n_args and inp.is_variable:
+                        aux.setdefault(inp.name, True)
+        return aux
+
+    def list_outputs(self) -> List[str]:
+        out = []
+        for node, idx in self._outputs:
+            out.append(node.output_names()[idx])
+        return out
+
+    def get_internals(self) -> "Symbol":
+        outs = []
+        for node in self.topo_nodes():
+            for i in range(node._num_outputs):
+                outs.append((node, i))
+        return Symbol(outs)
+
+    def get_children(self) -> Optional["Symbol"]:
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # ------------------------------------------------------------------ attr
+    def attr(self, key: str) -> Optional[str]:
+        node = self._outputs[0][0]
+        v = node.attrs.get(key)
+        return str(v) if v is not None else None
+
+    def list_attr(self) -> Dict[str, str]:
+        node = self._outputs[0][0]
+        return {k: str(v) for k, v in node.attrs.items()}
+
+    def attr_dict(self) -> Dict[str, Dict[str, str]]:
+        out = {}
+        for node in self.topo_nodes():
+            if node.attrs:
+                out[node.name] = {k: str(v) for k, v in node.attrs.items()}
+        return out
+
+    def _set_attr(self, **kwargs):
+        self._outputs[0][0].attrs.update(kwargs)
+
+    # ------------------------------------------------------------- inference
+    def infer_shape(self, *args, **kwargs):
+        arg_s, out_s, aux_s = self.infer_shape_partial(*args, **kwargs)
+        if arg_s is not None and any(s is None for s in arg_s):
+            missing = [n for n, s in zip(self.list_arguments(), arg_s)
+                       if s is None]
+            raise MXNetError("infer_shape incomplete; unknown shapes for "
+                             "args %s" % missing)
+        return arg_s, out_s, aux_s
+
+    def infer_shape_partial(self, *args, **kwargs):
+        """Forward shape propagation with per-op back-inference of parameter
+        shapes — the capability the reference got from the fixed-point
+        InferShape pass (``graph_executor.cc:826``)."""
+        known: Dict[str, Tuple[int, ...]] = {}
+        arg_names = self.list_arguments()
+        if args:
+            for nm, s in zip(arg_names, args):
+                if s is not None:
+                    known[nm] = tuple(s)
+        for k, v in kwargs.items():
+            known[k] = tuple(v)
+
+        node_out_shapes: Dict[Tuple[int, int], Any] = {}
+        var_shapes: Dict[str, Any] = {}
+
+        for node in self.topo_nodes():
+            if node.is_variable:
+                s = known.get(node.name)
+                if s is None:
+                    sa = node.attrs.get("__shape__")
+                    if sa is not None:
+                        from ..ops.registry import parse_tuple
+
+                        s = parse_tuple(sa)  # handles str round-trip via JSON
+                var_shapes.setdefault(node.name, s)
+                node_out_shapes[(id(node), 0)] = var_shapes[node.name]
+                continue
+            in_shapes = []
+            for inp, idx in node.inputs:
+                if inp.is_variable:
+                    in_shapes.append(var_shapes.get(inp.name))
+                else:
+                    in_shapes.append(node_out_shapes.get((id(inp), idx)))
+            out_shapes = self._infer_node(node, in_shapes)
+            for i, s in enumerate(out_shapes):
+                node_out_shapes[(id(node), i)] = s
+            # back-fill inferred input shapes into variables
+            for (inp, idx), s in zip(node.inputs, self._last_in_shapes):
+                if inp.is_variable and s is not None \
+                        and var_shapes.get(inp.name) is None:
+                    var_shapes[inp.name] = tuple(s)
+                    node_out_shapes[(id(inp), 0)] = tuple(s)
+
+        arg_shapes = [var_shapes.get(n) for n in arg_names]
+        out_shapes = [node_out_shapes.get((id(n), i))
+                      for n, i in self._outputs]
+        aux_shapes = [var_shapes.get(n)
+                      for n in self.list_auxiliary_states()]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def _infer_node(self, node: _Node, in_shapes):
+        """Infer node output shapes; uses the op rule if present, else
+        jax.eval_shape over the forward."""
+        op = node.op
+        if op.infer_shape is not None:
+            ins, outs, aux = op.infer_shape(
+                list(in_shapes), node.attrs)
+            self._last_in_shapes = list(ins) + list(aux)
+            return [tuple(s) if s is not None else None for s in outs]
+        self._last_in_shapes = in_shapes
+        if any(s is None for s in in_shapes):
+            n = op.get_num_outputs(node.attrs)
+            return [None] * n
+        import jax
+
+        from ..ops.registry import OpContext
+
+        def f(*arrs):
+            outs, _aux = op.apply(list(arrs), node.attrs,
+                                  OpContext(is_train=False, rng=None))
+            return tuple(outs)
+
+        specs = [jax.ShapeDtypeStruct(tuple(s), np.float32)
+                 for s in in_shapes]
+        try:
+            out = jax.eval_shape(f, *specs)
+        except Exception as e:
+            raise MXNetError("shape inference failed at node %s (%s): %s"
+                             % (node.name, op.name, e))
+        return [tuple(o.shape) for o in out]
+
+    def infer_type(self, *args, **kwargs):
+        """Type inference: default real type everywhere except explicitly
+        typed variables (simplified vs the reference but same API)."""
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for nm, t in zip(arg_names, args):
+                if t is not None:
+                    known[nm] = t
+        known.update(kwargs)
+        arg_types = [known.get(n, np.float32) for n in arg_names]
+        out_types = [np.float32] * len(self._outputs)
+        aux_types = [np.float32] * len(self.list_auxiliary_states())
+        return arg_types, out_types, aux_types
+
+    # ------------------------------------------------------------ arithmetic
+    def _compose_binary(self, other, opname, scalar_op, rscalar_op=None,
+                        rop=False):
+        if isinstance(other, Symbol):
+            return _create(opname, [self, other], {})
+        op = rscalar_op if (rop and rscalar_op) else scalar_op
+        return _create(op, [self], {"scalar": other})
+
+    def __add__(self, o):
+        return self._compose_binary(o, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._compose_binary(o, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._compose_binary(o, "elemwise_sub", "_minus_scalar",
+                                    "_rminus_scalar", rop=True)
+
+    def __mul__(self, o):
+        return self._compose_binary(o, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._compose_binary(o, "elemwise_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._compose_binary(o, "elemwise_div", "_div_scalar",
+                                    "_rdiv_scalar", rop=True)
+
+    def __pow__(self, o):
+        return self._compose_binary(o, "_power", "_power_scalar")
+
+    def __neg__(self):
+        return _create("negative", [self], {})
+
+    # ---------------------------------------------------------------- binder
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_exec=None, **kwargs):
+        from ..executor import Executor
+
+        return Executor._simple_bind(self, ctx, grad_req, type_dict,
+                                     group2ctx, shared_exec, kwargs)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+
+        return Executor._bind(self, ctx, args, args_grad, grad_req,
+                              aux_states, group2ctx, shared_exec)
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx, args=kwargs)
+        return ex.forward()
+
+    # ------------------------------------------------------------------ save
+    def tojson(self) -> str:
+        """Graph JSON (same structural idea as the reference symbol JSON:
+        nodes list + arg_nodes + heads)."""
+        nodes = self.topo_nodes()
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            out_nodes.append({
+                "op": "null" if n.is_variable else n.op.name,
+                "name": n.name,
+                "attrs": {k: str(v) for k, v in n.attrs.items()},
+                "inputs": [[nid[id(i)], idx, 0] for i, idx in n.inputs],
+            })
+        return json.dumps({
+            "nodes": out_nodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.is_variable],
+            "heads": [[nid[id(n)], i, 0] for n, i in self._outputs],
+            "attrs": {"tp_version": [1, 0]},
+        }, indent=2)
+
+    def save(self, fname: str) -> None:
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+
+# ---------------------------------------------------------------------------
+# construction
+# ---------------------------------------------------------------------------
+
+
+def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, **kwargs) -> Symbol:
+    """``mx.sym.Variable`` (``python/mxnet/symbol.py`` Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Variable name must be str")
+    attrs = attribute.current().get(attr)
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    if dtype is not None:
+        attrs["__dtype__"] = dtype_name(dtype_np(dtype))
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else \
+            init.dumps() if hasattr(init, "dumps") else str(init)
+    attrs.update({k: str(v) for k, v in kwargs.items()})
+    node = _Node(None, name, attrs, [])
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def _create(op_name: str, input_syms: List[Symbol], attrs: Dict[str, Any],
+            name: Optional[str] = None,
+            kw_inputs: Optional[Dict[str, Symbol]] = None) -> Symbol:
+    """Compose an op node; auto-create missing parameter variables the way
+    the reference auto-lists them (conv weight/bias appear in
+    list_arguments without the user declaring them)."""
+    op = get_op(op_name)
+    attrs = dict(attrs)
+    scope_attrs = attribute.current().get(None)
+    name = _name_mod.current().get(name, op.name)
+
+    arg_names = op.get_arg_names(attrs)
+    inputs: List[Tuple[_Node, int]] = []
+    if arg_names is None:
+        for s in input_syms:
+            if len(s._outputs) != 1:
+                raise MXNetError("cannot compose multi-output symbol as "
+                                 "a single input")
+            inputs.append(s._outputs[0])
+        attrs.setdefault("num_args", len(input_syms))
+    else:
+        expected = list(arg_names) + list(op.aux_names)
+        pos = list(input_syms)
+        kw_inputs = kw_inputs or {}
+        for i, arg in enumerate(expected):
+            if i < len(pos):
+                s = pos[i]
+            elif arg in kw_inputs:
+                s = kw_inputs[arg]
+            else:
+                # auto-create variable "{name}_{arg}"
+                s = Variable("%s_%s" % (name, arg))
+            if len(s._outputs) != 1:
+                raise MXNetError("input %s must be single-output" % arg)
+            inputs.append(s._outputs[0])
+
+    node_attrs = dict(scope_attrs)
+    node_attrs.update(attrs)
+    node = _Node(op, name, node_attrs, inputs)
+    n_out = node._num_outputs
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def _make_sym_func(op: OpDef, fname: str):
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        kwargs.pop("attr", None)
+        sym_inputs = [a for a in args if isinstance(a, Symbol)]
+        kw_syms = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+        attrs = {k: v for k, v in kwargs.items()
+                 if not isinstance(v, Symbol)}
+        return _create(op.name, sym_inputs, attrs, name=name,
+                       kw_inputs=kw_syms)
+
+    fn.__name__ = fname
+    fn.__doc__ = op.doc
+    fn.__module__ = __name__
+    return fn
+
+
+def _install():
+    mod = sys.modules[__name__]
+    for key in OPS.keys():
+        op = OPS.get(key)
+        for alias in [op.name] + op.aliases:
+            if not hasattr(mod, alias):
+                setattr(mod, alias, _make_sym_func(op, alias))
+
+
+_install()
+
+
+# creation-op symbolic wrappers need explicit shape; install friendly names
+def zeros(shape, dtype=None, **kwargs):
+    return _create("_zeros", [], {"shape": shape,
+                                  "dtype": dtype_name(dtype_np(dtype))},
+                   name=kwargs.get("name"))
+
+
+def ones(shape, dtype=None, **kwargs):
+    return _create("_ones", [], {"shape": shape,
+                                 "dtype": dtype_name(dtype_np(dtype))},
+                   name=kwargs.get("name"))
+
+
+# ---------------------------------------------------------------------------
+# load
+# ---------------------------------------------------------------------------
+
+
+def load_json(json_str: str) -> Symbol:
+    data = json.loads(json_str)
+    nodes_meta = data["nodes"]
+    built: List[_Node] = []
+    for meta in nodes_meta:
+        attrs = dict(meta.get("attrs", meta.get("param", {})) or {})
+        if meta["op"] == "null":
+            node = _Node(None, meta["name"], attrs, [])
+        else:
+            op = get_op(meta["op"])
+            inputs = [(built[i], idx) for i, idx, *_ in meta["inputs"]]
+            node = _Node(op, meta["name"], attrs, inputs)
+        built.append(node)
+    heads = [(built[i], idx) for i, idx, *_ in data["heads"]]
+    return Symbol(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())

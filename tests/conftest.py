@@ -1,18 +1,19 @@
-"""Test config: force an 8-device virtual CPU mesh BEFORE jax initializes.
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax initializes
+its backend.
 
-Mirrors the reference test strategy (SURVEY.md §4): CPU contexts stand in for
-devices; multi-device/multi-"chip" behavior is tested with
-``--xla_force_host_platform_device_count`` the way the reference used
-localhost multi-process ps-lite.
+Mirrors the reference test strategy (SURVEY.md §4): CPU contexts stand in
+for devices; multi-device/multi-"chip" behavior is tested on a virtual CPU
+mesh the way the reference used localhost multi-process ps-lite.
+
+NOTE: the session env pins ``JAX_PLATFORMS=axon`` (single real TPU chip via
+tunnel) and the axon plugin ignores the env override, so we must use the
+jax.config API — and it must run before any backend is initialized.
 """
 import os
 
-# the session env pins JAX_PLATFORMS=axon (the real TPU tunnel); tests run on
-# a virtual multi-device CPU backend instead, so override unconditionally
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-# Deterministic CPU numerics for oracle comparisons
-os.environ.setdefault("TP_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,191 @@
+"""Module tests — reference ``tests/python/unittest/test_module.py`` +
+``tests/python/train/test_mlp.py`` convergence philosophy (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def _make_dataset(n=400, nclass=4, dim=16, seed=3):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(nclass, dim).astype(np.float32) * 3
+    y = rng.randint(0, nclass, n)
+    x = centers[y] + rng.randn(n, dim).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def _mlp(nclass=4):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=32)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=nclass)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_fit_mlp_converges():
+    x, y = _make_dataset()
+    train = mx.io.NDArrayIter(x, y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=5, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            eval_metric="acc",
+            initializer=mx.initializer.Xavier())
+    score = mod.score(train, "acc")
+    assert score[0][1] > 0.95, "MLP did not converge: %s" % score
+
+
+def test_module_predict_and_score():
+    x, y = _make_dataset(n=100)
+    it = mx.io.NDArrayIter(x, y, batch_size=25)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (100, 4)
+    np.testing.assert_allclose(out.asnumpy().sum(1), np.ones(100),
+                               rtol=1e-5)
+
+
+def test_module_multi_device_data_parallel():
+    # 2 CPU contexts stand in for 2 chips (reference multi_lenet pattern)
+    x, y = _make_dataset(n=200)
+    train = mx.io.NDArrayIter(x, y, batch_size=40)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            kvstore="local",
+            initializer=mx.initializer.Xavier())
+    score = mod.score(train, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_multi_device_matches_single_device():
+    # numerical equivalence single- vs multi-device (nightly multi_lenet.py)
+    x, y = _make_dataset(n=80, seed=11)
+    np.random.seed(0)
+    mx.random.seed(0)
+
+    def run(ctxs):
+        it = mx.io.NDArrayIter(x, y, batch_size=40)
+        mod = mx.mod.Module(_mlp(), context=ctxs)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        np.random.seed(42)
+        mod.init_params(initializer=mx.initializer.Xavier())
+        mod.init_optimizer(kvstore="local", optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        for _ in range(3):
+            it.reset()
+            for batch in it:
+                mod.forward_backward(batch)
+                mod.update()
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    p1 = run([mx.cpu(0)])
+    p2 = run([mx.cpu(0), mx.cpu(1)])
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p2[k], rtol=2e-4, atol=1e-5,
+                                   err_msg=k)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    x, y = _make_dataset(n=100)
+    it = mx.io.NDArrayIter(x, y, batch_size=20)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 3)
+
+    mod2 = mx.mod.Module.load(prefix, 3)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    out1 = mod.predict(it).asnumpy()
+    out2 = mod2.predict(it).asnumpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-5)
+
+
+def test_optimizers_each_reduce_loss():
+    x, y = _make_dataset(n=200, seed=5)
+    for opt, params in [
+        ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+        ("adam", {"learning_rate": 0.01}),
+        ("rmsprop", {"learning_rate": 0.01}),
+        ("adagrad", {"learning_rate": 0.1}),
+        ("adadelta", {}),
+        ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+        ("ftrl", {"learning_rate": 0.5}),
+        ("adamax", {"learning_rate": 0.01}),
+        ("nadam", {"learning_rate": 0.01}),
+    ]:
+        train = mx.io.NDArrayIter(x, y, batch_size=50, shuffle=True)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.fit(train, num_epoch=3, optimizer=opt,
+                optimizer_params=params,
+                initializer=mx.initializer.Xavier())
+        score = mod.score(train, "acc")[0][1]
+        assert score > 0.5, "%s failed to learn (acc=%.3f)" % (opt, score)
+
+
+def test_metrics():
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 0, 0])
+    m = mx.metric.Accuracy()
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 2.0 / 3) < 1e-6
+
+    ce = mx.metric.create("ce")
+    ce.update([label], [pred])
+    expect = -(np.log(0.9) + np.log(0.8) + np.log(0.3)) / 3
+    assert abs(ce.get()[1] - expect) < 1e-5
+
+    comp = mx.metric.create(["acc", "ce"])
+    comp.update([label], [pred])
+    names, vals = comp.get()
+    assert len(names) == 2
+
+    mse = mx.metric.MSE()
+    mse.update([mx.nd.array([1.0, 2.0])],
+               [mx.nd.array([[1.5], [2.5]])])
+    assert abs(mse.get()[1] - 0.25) < 1e-6
+
+
+def test_lr_scheduler():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(5) == 1.0
+    assert s(25) == 0.25
+
+    ms = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
+    ms.base_lr = 1.0
+    assert ms(3) == 1.0
+    assert abs(ms(10) - 0.1) < 1e-9
+
+
+def test_ndarray_iter_pad_and_shuffle():
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(x, np.zeros(10, np.float32), batch_size=4,
+                           last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    it2 = mx.io.NDArrayIter(x, None, batch_size=5,
+                            last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_initializers():
+    w = mx.nd.zeros((64, 32))
+    mx.initializer.Xavier()(mx.initializer.InitDesc("fc_weight"), w)
+    arr = w.asnumpy()
+    assert arr.std() > 0
+    bound = np.sqrt(3.0 / ((64 + 32) / 2))
+    assert abs(arr).max() <= bound + 1e-6
+
+    b = mx.nd.ones((5,))
+    mx.initializer.Uniform()(mx.initializer.InitDesc("fc_bias"), b)
+    np.testing.assert_allclose(b.asnumpy(), np.zeros(5))  # bias → 0
+
+    g = mx.nd.zeros((5,))
+    mx.initializer.Uniform()(mx.initializer.InitDesc("bn_gamma"), g)
+    np.testing.assert_allclose(g.asnumpy(), np.ones(5))

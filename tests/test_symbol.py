@@ -1,0 +1,181 @@
+"""Symbol + Executor tests — reference ``test_symbol.py`` /
+``test_executor.py`` / ``test_infer_shape.py`` semantics."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+
+
+def _mlp_sym():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_compose_and_listing():
+    net = _mlp_sym()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight",
+                    "fc2_bias", "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    internals = net.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+
+
+def test_auto_naming():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4)
+    assert fc.name.startswith("fullyconnected")
+    fc2 = mx.sym.FullyConnected(data, num_hidden=4)
+    assert fc2.name != fc.name
+
+
+def test_infer_shape_mlp():
+    net = _mlp_sym()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 100))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 100)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (10, 16)
+    assert out_shapes == [(32, 10)]
+
+
+def test_infer_shape_conv():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1))
+    pool = mx.sym.Pooling(conv, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max")
+    arg_shapes, out_shapes, _ = pool.infer_shape(data=(2, 3, 28, 28))
+    d = dict(zip(pool.list_arguments(), arg_shapes))
+    assert d[conv.name + "_weight"] == (8, 3, 3, 3)
+    assert out_shapes == [(2, 8, 14, 14)]
+
+
+def test_infer_shape_batchnorm_aux():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn")
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    arg_shapes, _, aux_shapes = bn.infer_shape(data=(4, 3, 8, 8))
+    assert aux_shapes == [(3,), (3,)]
+    assert bn.list_arguments() == ["data", "bn_gamma", "bn_beta"]
+
+
+def test_symbol_arithmetic():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a * 2 + b / 4 - 3
+    ex = c.bind(mx.cpu(), args={"a": mx.nd.array([2.0]),
+                                "b": mx.nd.array([8.0])})
+    out = ex.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), [2 * 2 + 8 / 4 - 3])
+
+
+def test_simple_bind_forward_backward():
+    np.random.seed(0)
+    net = _mlp_sym()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(8, 20))
+    # init params
+    for name, arr in ex.arg_dict.items():
+        if name.endswith("weight"):
+            arr[:] = np.random.randn(*arr.shape).astype(np.float32) * 0.1
+    x = np.random.randn(8, 20).astype(np.float32)
+    y = np.random.randint(0, 10, (8,)).astype(np.float32)
+    ex.forward(is_train=True, data=x, softmax_label=y)
+    out = ex.outputs[0].asnumpy()
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(8), rtol=1e-5)
+    ex.backward()
+    gw = ex.grad_dict["fc2_weight"].asnumpy()
+    assert np.abs(gw).sum() > 0
+    # data grad exists under write req
+    assert ex.grad_dict["data"].shape == (8, 20)
+
+
+def test_executor_grad_matches_finite_diff():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    loss = mx.sym.sum(mx.sym.square(data * w))
+    ex = loss.bind(mx.cpu(),
+                   args={"data": mx.nd.array([1.0, 2.0]),
+                         "w": mx.nd.array([3.0, 4.0])},
+                   args_grad={"w": mx.nd.zeros((2,))},
+                   grad_req={"w": "write", "data": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    # d/dw sum((d*w)^2) = 2*d^2*w
+    np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(),
+                               2 * np.array([1., 4.]) * np.array([3., 4.]),
+                               rtol=1e-5)
+
+
+def test_grad_req_add():
+    x_nd = mx.nd.array([2.0])
+    g = mx.nd.zeros((1,))
+    x = mx.sym.Variable("x")
+    y = mx.sym.square(x)
+    ex = y.bind(mx.cpu(), args={"x": x_nd}, args_grad={"x": g},
+                grad_req={"x": "add"})
+    for _ in range(3):
+        ex.forward(is_train=True)
+        ex.backward()
+    np.testing.assert_allclose(g.asnumpy(), [12.0])
+
+
+def test_batchnorm_executor_aux_updates():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn", momentum=0.5)
+    ex = bn.simple_bind(ctx=mx.cpu(), data=(16, 4))
+    x = np.random.randn(16, 4).astype(np.float32) * 3 + 2
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    ex.forward(is_train=True, data=x)
+    _ = ex.outputs[0].asnumpy()
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert np.abs(mm).sum() > 0  # moved toward batch mean
+    # eval mode uses moving stats
+    ex.forward(is_train=False, data=x)
+    out_eval = ex.outputs[0].asnumpy()
+    assert out_eval.shape == (16, 4)
+
+
+def test_save_load_json(tmp_path):
+    net = _mlp_sym()
+    fname = str(tmp_path / "net.json")
+    net.save(fname)
+    back = mx.sym.load(fname)
+    assert back.list_arguments() == net.list_arguments()
+    assert back.list_outputs() == net.list_outputs()
+    # loaded symbol is executable
+    ex = back.simple_bind(ctx=mx.cpu(), data=(2, 10))
+    ex.forward(is_train=False,
+               data=np.zeros((2, 10), dtype=np.float32))
+    assert ex.outputs[0].shape == (2, 10)
+
+
+def test_group_and_getitem():
+    a = mx.sym.Variable("a")
+    s1 = mx.sym.square(a, name="sq")
+    s2 = mx.sym.sqrt(a, name="rt")
+    g = mx.sym.Group([s1, s2])
+    assert g.list_outputs() == ["sq_output", "rt_output"]
+    ex = g.bind(mx.cpu(), args={"a": mx.nd.array([4.0])})
+    outs = ex.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), [16.0])
+    np.testing.assert_allclose(outs[1].asnumpy(), [2.0])
+
+
+def test_shared_exec_memory_sharing():
+    # bucketing mechanism: shared_exec reuses param arrays
+    net = _mlp_sym()
+    ex1 = net.simple_bind(ctx=mx.cpu(), data=(4, 10))
+    ex2 = net.simple_bind(ctx=mx.cpu(), data=(8, 10), shared_exec=ex1)
+    assert ex2.arg_dict["fc1_weight"] is ex1.arg_dict["fc1_weight"]
+    assert ex2.arg_dict["data"] is not ex1.arg_dict["data"]
+
+
+def test_slice_channel_symbolic():
+    data = mx.sym.Variable("data")
+    parts = mx.sym.SliceChannel(data, num_outputs=2, axis=1, name="sc")
+    assert len(parts.list_outputs()) == 2
+    ex = parts.bind(mx.cpu(), args={"data": mx.nd.ones((2, 4))})
+    outs = ex.forward()
+    assert outs[0].shape == (2, 2)

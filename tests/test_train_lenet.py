@@ -1,0 +1,58 @@
+"""End-to-end LeNet MNIST (BASELINE config 1) — reference
+``tests/python/train/test_conv.py`` pattern: small convergence run with an
+accuracy threshold."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+
+
+def test_lenet_mnist_convergence():
+    mx.random.seed(0)
+    np.random.seed(0)
+    train = mx.io.MNISTIter(batch_size=64, shuffle=True, num_examples=1024,
+                            seed=0)
+    val = mx.io.MNISTIter(batch_size=64, shuffle=False, num_examples=256,
+                          seed=1)
+    net = mx.models.lenet(num_classes=10)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(64, 10))
+    score = mod.score(train, "acc")[0][1]
+    # synthetic MNIST templates are learnable to near-perfect quickly
+    assert score > 0.9, "LeNet failed to converge: acc=%.3f" % score
+
+
+def test_model_zoo_shapes():
+    # every zoo symbol infers shapes end-to-end
+    cases = [
+        (mx.models.mlp(), (2, 784)),
+        (mx.models.lenet(), (2, 1, 28, 28)),
+        (mx.models.alexnet(num_classes=100), (2, 3, 224, 224)),
+        (mx.models.resnet(num_layers=18, num_classes=10,
+                          image_shape=(3, 32, 32)), (2, 3, 32, 32)),
+        (mx.models.get_symbol("resnet50", num_classes=1000),
+         (2, 3, 224, 224)),
+        (mx.models.vgg(num_layers=11, num_classes=10), (2, 3, 224, 224)),
+        (mx.models.inception_bn(num_classes=10), (2, 3, 224, 224)),
+    ]
+    for net, dshape in cases:
+        arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=dshape)
+        assert out_shapes[0][0] == 2
+        assert all(s is not None for s in arg_shapes)
+
+
+def test_resnet18_cifar_forward():
+    net = mx.models.resnet(num_layers=18, num_classes=10,
+                           image_shape=(3, 32, 32))
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 3, 32, 32))
+    for name, arr in ex.arg_dict.items():
+        if name.endswith("weight"):
+            arr[:] = np.random.randn(*arr.shape).astype(np.float32) * 0.05
+    x = np.random.rand(2, 3, 32, 32).astype(np.float32)
+    ex.forward(is_train=False, data=x,
+               softmax_label=np.zeros(2, np.float32))
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(1), np.ones(2), rtol=1e-4)
