@@ -1,0 +1,14 @@
+"""Gluon — imperative/hybrid NN API (``python/mxnet/gluon/``)."""
+from .parameter import Parameter, ParameterDict
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo
+from . import rnn
+
+__all__ = ["Parameter", "ParameterDict", "Block", "HybridBlock",
+           "SymbolBlock", "Trainer", "nn", "loss", "data", "utils",
+           "model_zoo", "rnn"]

@@ -1,0 +1,342 @@
+"""Gluon Block / HybridBlock (``python/mxnet/gluon/block.py:115,283``).
+
+TPU-native hybridize: instead of the reference's CachedOp over a composed
+symbol, ``hybridize()`` traces ``hybrid_forward`` once with symbolic
+placeholders into a Symbol DAG, lowers it through the shared
+:mod:`..lowering`, and compiles with ``jax.jit`` — giving whole-block XLA
+fusion (the Gluon analog of the executor's fused program).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from typing import Any, Dict, List, Optional
+
+from .. import name as name_mod, symbol as sym_mod
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope:
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = name_mod.current().get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = "%s%d_" % (hint, count)
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        _BlockScope._current.value = self._old_scope
+
+
+class Block:
+    """Base neural-network building block."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children: List[Block] = []
+        self._reg_params: Dict[str, Parameter] = {}
+
+    def _alias(self) -> str:
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)" if self._children else "{name}()"
+        modstr = "\n".join("  (%d): %r" % (i, c)
+                           for i, c in enumerate(self._children))
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pat = re.compile(select)
+            ret.update(ParameterDict(self._params.prefix))
+            for name, value in self.params.items():
+                if pat.match(name):
+                    ret._params[name] = value
+        for child in self._children:
+            child_params = child.collect_params(select)
+            for name, value in child_params.items():
+                ret._params[name] = value
+        return ret
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self.register_child(value)
+        elif isinstance(value, Parameter):
+            if name in getattr(self, "_reg_params", {}):
+                raise MXNetError("parameter %s already registered" % name)
+            self._reg_params[name] = value
+            self._params._params[value.name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block: "Block") -> None:
+        self._children.append(block)
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False) -> None:
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def save_params(self, fname: str) -> None:
+        self.collect_params().save(fname, strip_prefix=self.prefix)
+
+    def load_params(self, fname: str, ctx=None, allow_missing=False,
+                    ignore_extra=False) -> None:
+        self.collect_params().load(fname, ctx, allow_missing, ignore_extra,
+                                   restore_prefix=self.prefix)
+
+    def cast(self, dtype) -> None:
+        for child in self._children:
+            child.cast(dtype)
+        for p in self.params.values():
+            p.cast(dtype)
+
+    def hybridize(self, active: bool = True) -> None:
+        for child in self._children:
+            child.hybridize(active)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+class HybridBlock(Block):
+    """Block with a functional ``hybrid_forward(F, x, **params)`` that can
+    run imperatively (F = mx.nd) or compiled (symbol trace + jit)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_fn = None
+        self._cached_param_names = None
+
+    def hybridize(self, active: bool = True) -> None:
+        self._active = active
+        self._cached_fn = None
+        super().hybridize(active)
+
+    def cast(self, dtype):
+        self._cached_fn = None
+        super().cast(dtype)
+
+    def register_child(self, block):
+        if not isinstance(block, HybridBlock):
+            raise MXNetError(
+                "HybridBlock children must be HybridBlocks; found %s"
+                % type(block))
+        super().register_child(block)
+        self._cached_fn = None
+
+    def infer_shape(self, *args):
+        """Deferred-shape resolution by symbolic tracing."""
+        self._build_trace(args)
+
+    # ------------------------------------------------------------- tracing
+    def _trace_symbol(self, n_inputs: int):
+        inputs = [sym_mod.Variable("data%d" % i if n_inputs > 1 else "data")
+                  for i in range(n_inputs)]
+        out = self._call_tree(sym_mod, *inputs)
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        return inputs, out
+
+    def _call_tree(self, F, *args):
+        """Call hybrid_forward recursively with F=sym, feeding params as
+        symbol variables."""
+        params = {k: p.var() for k, p in self._reg_params.items()}
+        return self.hybrid_forward(F, *args, **params)
+
+    def _build_trace(self, args):
+        """Infer deferred shapes + build the jitted cached fn."""
+        inputs, out = self._trace_symbol(len(args))
+        shapes = {}
+        for iv, a in zip(inputs, args):
+            shapes[iv.name] = a.shape
+        arg_shapes, _, aux_shapes = out.infer_shape_partial(**shapes)
+        arg_names = out.list_arguments()
+        shape_of = dict(zip(arg_names, arg_shapes))
+        shape_of.update(dict(zip(out.list_auxiliary_states(), aux_shapes)))
+        # finish deferred param inits
+        all_params = self.collect_params()
+        for name, p in all_params.items():
+            s = shape_of.get(name)
+            if p._deferred_init and s is not None \
+                    and all(d > 0 for d in s):
+                p._finish_deferred_init(s)
+        return inputs, out
+
+    def _get_cached(self, args):
+        if self._cached_fn is None:
+            import jax
+
+            inputs, out = self._build_trace(args)
+            fwd = None
+            from ..lowering import lower_symbol
+
+            input_names = [iv.name for iv in inputs]
+            aux_names = out.list_auxiliary_states()
+            self._cached_out = out
+            self._cached_input_names = input_names
+            self._cached_aux_names = aux_names
+            all_params = {p.name: p
+                         for p in self.collect_params().values()}
+            self._cached_params = all_params
+
+            fwd_train = lower_symbol(out, True)
+            fwd_test = lower_symbol(out, False)
+            self._cached_fn = {True: jax.jit(fwd_train),
+                               False: jax.jit(fwd_test)}
+        return self._cached_fn
+
+    def forward(self, *args):
+        from .. import autograd as ag
+        from .. import ndarray as nd
+        from .. import random as _random
+
+        if args and isinstance(args[0], sym_mod.Symbol):
+            # symbolic composition (tracing pass / user symbol input)
+            params = {k: p.var() for k, p in self._reg_params.items()}
+            return self.hybrid_forward(sym_mod, *args, **params)
+
+        if not self._active:
+            params = {}
+            try:
+                for k, p in self._reg_params.items():
+                    params[k] = p.data(args[0].context if args else None)
+            except DeferredInitializationError:
+                self._build_trace(args)
+                for k, p in self._reg_params.items():
+                    params[k] = p.data(args[0].context if args else None)
+            return self.hybrid_forward(nd, *args, **params)
+
+        # hybrid path: jitted whole-block program
+        try:
+            fns = self._get_cached(args)
+        except DeferredInitializationError:
+            self._build_trace(args)
+            fns = self._get_cached(args)
+        is_train = ag.is_training()
+        arg_vals = {}
+        for name, a in zip(self._cached_input_names, args):
+            arg_vals[name] = a.data
+        for pname, p in self._cached_params.items():
+            if pname not in self._cached_aux_names:
+                arg_vals[pname] = p.data().data
+        aux_vals = {n: self._cached_params[n].data().data
+                    for n in self._cached_aux_names}
+        if ag.is_recording():
+            # fall back to imperative tape path for autograd correctness
+            params = {k: p.data() for k, p in self._reg_params.items()}
+            return self.hybrid_forward(nd, *args, **params)
+        outs, new_aux = fns[is_train](arg_vals, aux_vals,
+                                      _random.next_key())
+        for n, v in new_aux.items():
+            self._cached_params[n].data()._set_data(v)
+        res = [NDArray(o) for o in outs]
+        return res[0] if len(res) == 1 else res
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap an arbitrary Symbol as a block
+    (reference ``gluon.SymbolBlock``)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(list(outputs))
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._sym_out = outputs
+        self._sym_inputs = [i.name for i in inputs]
+        input_set = set(self._sym_inputs)
+        for name in outputs.list_arguments():
+            if name not in input_set:
+                self.params.get(name, allow_deferred_init=True)
+        for name in outputs.list_auxiliary_states():
+            self.params.get(name, allow_deferred_init=True,
+                            grad_req="null")
+
+    def forward(self, *args):
+        from .. import random as _random
+        from ..lowering import lower_symbol
+        from .. import autograd as ag
+
+        shapes = dict(zip(self._sym_inputs, [a.shape for a in args]))
+        arg_shapes, _, aux_shapes = \
+            self._sym_out.infer_shape_partial(**shapes)
+        names = self._sym_out.list_arguments()
+        shape_of = dict(zip(names, arg_shapes))
+        aux_names = self._sym_out.list_auxiliary_states()
+        shape_of.update(dict(zip(aux_names, aux_shapes)))
+        for name, p in self.params.items():
+            if p._deferred_init and shape_of.get(name) is not None:
+                p._finish_deferred_init(shape_of[name])
+        fwd = lower_symbol(self._sym_out, ag.is_training())
+        arg_vals = {n: a.data for n, a in zip(self._sym_inputs, args)}
+        for name, p in self.params.items():
+            if name not in aux_names:
+                arg_vals[name] = p.data().data
+        aux_vals = {n: self.params[n].data().data for n in aux_names}
+        outs, new_aux = fwd(arg_vals, aux_vals, _random.next_key())
+        for n, v in new_aux.items():
+            self.params[n].data()._set_data(v)
+        res = [NDArray(o) for o in outs]
+        return res[0] if len(res) == 1 else res

@@ -1,0 +1,58 @@
+"""DataLoader (``python/mxnet/gluon/data/dataloader.py:40-84`` — the
+reference at v0.11 is single-threaded; we match that API and add optional
+thread-based prefetch, the TPU-host analog of its later worker pools)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray import array as nd_array
+from ...ndarray.ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.stack([d.data for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    data = np.asarray(data)
+    return nd_array(data)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("batch_size required when no "
+                                 "batch_sampler")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must be False with sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise MXNetError("batch_size/shuffle/sampler/last_batch "
+                             "conflict with batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+
+    def __iter__(self):
+        for batch in self._batch_sampler:
+            yield self._batchify_fn([self._dataset[int(i)]
+                                     for i in batch])
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,89 @@
+"""Datasets (``python/mxnet/gluon/data/dataset.py``)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+
+__all__ = ["Dataset", "ArrayDataset", "SimpleDataset", "RecordFileDataset"]
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def transform(self, fn, lazy=True):
+        return _LazyTransformDataset(self, fn)
+
+    def transform_first(self, fn, lazy=True):
+        def first(x, *rest):
+            return (fn(x),) + rest if rest else fn(x)
+
+        return self.transform(
+            lambda *item: (fn(item[0]),) + item[1:]
+            if len(item) > 1 else fn(item[0]))
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, data, fn):
+        self._data = data
+        self._fn = fn
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        item = self._data[idx]
+        if isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+
+class ArrayDataset(Dataset):
+    """Zip of arrays/lists (reference ``ArrayDataset``)."""
+
+    def __init__(self, *args):
+        assert len(args) > 0
+        self._length = len(args[0])
+        self._data = []
+        for a in args:
+            if len(a) != self._length:
+                raise MXNetError("all arrays must have the same length")
+            self._data.append(a)
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)
+
+    def __len__(self):
+        return self._length
+
+
+class SimpleDataset(Dataset):
+    def __init__(self, data):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class RecordFileDataset(Dataset):
+    """Dataset over a RecordIO file (``gluon/data/dataset.py``
+    RecordFileDataset, backed by our recordio module)."""
+
+    def __init__(self, filename):
+        from ...recordio import IndexedRecordIO
+
+        idx_file = filename[:filename.rfind(".")] + ".idx"
+        self._record = IndexedRecordIO(idx_file, filename, "r")
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])
+
+    def __len__(self):
+        return len(self._record.keys)

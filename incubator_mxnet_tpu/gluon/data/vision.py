@@ -1,0 +1,108 @@
+"""Vision datasets (``python/mxnet/gluon/data/vision.py``): MNIST,
+FashionMNIST, CIFAR10 — reading the standard on-disk formats when present,
+else deterministic synthetic data (zero-egress environment, SURVEY.md §4
+"synthetic data" fixture philosophy)."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...ndarray import array as nd_array
+from .dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+
+class MNIST(_DownloadedDataset):
+    _N_SYNTH = 6000
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        prefix = "train" if self._train else "t10k"
+        img_path = os.path.join(self._root,
+                                "%s-images-idx3-ubyte.gz" % prefix)
+        lbl_path = os.path.join(self._root,
+                                "%s-labels-idx1-ubyte.gz" % prefix)
+        if os.path.exists(img_path) and os.path.exists(lbl_path):
+            with gzip.open(lbl_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                label = np.frombuffer(f.read(), dtype=np.uint8
+                                      ).astype(np.int32)
+            with gzip.open(img_path, "rb") as f:
+                struct.unpack(">IIII", f.read(16))
+                data = np.frombuffer(f.read(), dtype=np.uint8)
+                data = data.reshape(len(label), 28, 28, 1)
+        else:
+            rng = np.random.RandomState(42 if self._train else 43)
+            n = self._N_SYNTH if self._train else self._N_SYNTH // 6
+            templates = rng.rand(10, 28, 28, 1)
+            label = rng.randint(0, 10, n).astype(np.int32)
+            data = np.clip(templates[label]
+                           + rng.randn(n, 28, 28, 1) * 0.3, 0, 1) * 255
+            data = data.astype(np.uint8)
+        self._data = nd_array(data, dtype=np.uint8)
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None):
+        super(MNIST, self).__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        fname = os.path.join(self._root,
+                             "data_batch_1.bin" if self._train
+                             else "test_batch.bin")
+        if os.path.exists(fname):
+            data, label = [], []
+            files = ["data_batch_%d.bin" % i for i in range(1, 6)] \
+                if self._train else ["test_batch.bin"]
+            for f in files:
+                raw = np.fromfile(os.path.join(self._root, f),
+                                  dtype=np.uint8)
+                raw = raw.reshape(-1, 3073)
+                label.append(raw[:, 0].astype(np.int32))
+                data.append(raw[:, 1:].reshape(-1, 3, 32, 32)
+                            .transpose(0, 2, 3, 1))
+            data = np.concatenate(data)
+            label = np.concatenate(label)
+        else:
+            rng = np.random.RandomState(7 if self._train else 8)
+            n = 5000 if self._train else 1000
+            templates = rng.rand(10, 32, 32, 3)
+            label = rng.randint(0, 10, n).astype(np.int32)
+            data = np.clip(templates[label]
+                           + rng.randn(n, 32, 32, 3) * 0.25, 0, 1) * 255
+            data = data.astype(np.uint8)
+        self._data = nd_array(data, dtype=np.uint8)
+        self._label = label

@@ -1,0 +1,564 @@
+"""Gluon vision model zoo
+(``python/mxnet/gluon/model_zoo/vision/``: alexnet, densenet, inception,
+resnet v1/v2, squeezenet, vgg).  Pretrained-weight download is not available
+in this zero-egress environment; ``pretrained=True`` loads from a local
+``root`` path when the file exists and raises otherwise (the
+``model_store.py`` role)."""
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+from .. import nn
+from ..block import HybridBlock
+
+__all__ = ["get_model", "resnet18_v1", "resnet34_v1", "resnet50_v1",
+           "resnet101_v1", "resnet152_v1", "resnet18_v2", "resnet34_v2",
+           "resnet50_v2", "resnet101_v2", "resnet152_v2", "vgg11", "vgg13",
+           "vgg16", "vgg19", "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn",
+           "alexnet", "squeezenet1_0", "squeezenet1_1", "densenet121",
+           "densenet161", "densenet169", "densenet201", "mlp_model"]
+
+
+def _maybe_load(net, name, pretrained, root, ctx):
+    if pretrained:
+        path = os.path.join(os.path.expanduser(root), "%s.params" % name)
+        if not os.path.exists(path):
+            raise MXNetError(
+                "pretrained weights for %s not found at %s (no network "
+                "egress; place weights there manually)" % (name, path))
+        net.load_params(path, ctx=ctx)
+    return net
+
+
+# ---------------------------------------------------------------------------
+# ResNet v1/v2
+# ---------------------------------------------------------------------------
+
+
+class BasicBlockV1(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential(prefix="")
+        self.body.add(nn.Conv2D(channels, 3, stride, 1,
+                                in_channels=in_channels))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(channels, 3, 1, 1, in_channels=channels))
+        self.body.add(nn.BatchNorm())
+        if downsample:
+            self.downsample = nn.HybridSequential(prefix="")
+            self.downsample.add(nn.Conv2D(channels, 1, stride,
+                                          use_bias=False,
+                                          in_channels=in_channels))
+            self.downsample.add(nn.BatchNorm())
+        else:
+            self.downsample = None
+
+    def hybrid_forward(self, F, x):
+        residual = x
+        x = self.body(x)
+        if self.downsample is not None:
+            residual = self.downsample(residual)
+        return F.Activation(residual + x, act_type="relu")
+
+
+class BottleneckV1(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential(prefix="")
+        self.body.add(nn.Conv2D(channels // 4, 1, stride))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(channels // 4, 3, 1, 1))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(channels, 1, 1))
+        self.body.add(nn.BatchNorm())
+        if downsample:
+            self.downsample = nn.HybridSequential(prefix="")
+            self.downsample.add(nn.Conv2D(channels, 1, stride,
+                                          use_bias=False))
+            self.downsample.add(nn.BatchNorm())
+        else:
+            self.downsample = None
+
+    def hybrid_forward(self, F, x):
+        residual = x
+        x = self.body(x)
+        if self.downsample is not None:
+            residual = self.downsample(residual)
+        return F.Activation(residual + x, act_type="relu")
+
+
+class BasicBlockV2(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.bn1 = nn.BatchNorm()
+        self.conv1 = nn.Conv2D(channels, 3, stride, 1, use_bias=False)
+        self.bn2 = nn.BatchNorm()
+        self.conv2 = nn.Conv2D(channels, 3, 1, 1, use_bias=False)
+        if downsample:
+            self.downsample = nn.Conv2D(channels, 1, stride,
+                                        use_bias=False)
+        else:
+            self.downsample = None
+
+    def hybrid_forward(self, F, x):
+        residual = x
+        x = self.bn1(x)
+        x = F.Activation(x, act_type="relu")
+        if self.downsample is not None:
+            residual = self.downsample(x)
+        x = self.conv1(x)
+        x = self.bn2(x)
+        x = F.Activation(x, act_type="relu")
+        x = self.conv2(x)
+        return x + residual
+
+
+class BottleneckV2(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.bn1 = nn.BatchNorm()
+        self.conv1 = nn.Conv2D(channels // 4, 1, 1, use_bias=False)
+        self.bn2 = nn.BatchNorm()
+        self.conv2 = nn.Conv2D(channels // 4, 3, stride, 1, use_bias=False)
+        self.bn3 = nn.BatchNorm()
+        self.conv3 = nn.Conv2D(channels, 1, 1, use_bias=False)
+        if downsample:
+            self.downsample = nn.Conv2D(channels, 1, stride,
+                                        use_bias=False)
+        else:
+            self.downsample = None
+
+    def hybrid_forward(self, F, x):
+        residual = x
+        x = self.bn1(x)
+        x = F.Activation(x, act_type="relu")
+        if self.downsample is not None:
+            residual = self.downsample(x)
+        x = self.conv1(x)
+        x = self.bn2(x)
+        x = F.Activation(x, act_type="relu")
+        x = self.conv2(x)
+        x = self.bn3(x)
+        x = F.Activation(x, act_type="relu")
+        x = self.conv3(x)
+        return x + residual
+
+
+_RESNET_SPEC = {18: ("basic", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+                34: ("basic", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+                50: ("bottle", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+                101: ("bottle", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+                152: ("bottle", [3, 8, 36, 3], [64, 256, 512, 1024, 2048])}
+
+
+class ResNetV1(HybridBlock):
+    def __init__(self, block, layers, channels, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
+                                        use_bias=False))
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(3, 2, 1))
+            for i, num_layer in enumerate(layers):
+                stride = 1 if i == 0 else 2
+                self.features.add(self._make_layer(
+                    block, num_layer, channels[i + 1], stride, i + 1))
+            self.features.add(nn.GlobalAvgPool2D())
+            self.output = nn.Dense(classes)
+
+    def _make_layer(self, block, layers, channels, stride, stage_index):
+        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
+        with layer.name_scope():
+            layer.add(block(channels, stride, True))
+            for _ in range(layers - 1):
+                layer.add(block(channels, 1, False))
+        return layer
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+class ResNetV2(ResNetV1):
+    def __init__(self, block, layers, channels, classes=1000, **kwargs):
+        HybridBlock.__init__(self, **kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(nn.BatchNorm(scale=False, center=False))
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
+                                        use_bias=False))
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(3, 2, 1))
+            for i, num_layer in enumerate(layers):
+                stride = 1 if i == 0 else 2
+                self.features.add(self._make_layer(
+                    block, num_layer, channels[i + 1], stride, i + 1))
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.GlobalAvgPool2D())
+            self.output = nn.Dense(classes)
+
+
+def _resnet(version, num_layers, pretrained=False, ctx=None,
+            root="~/.mxnet/models", **kwargs):
+    kind, layers, channels = _RESNET_SPEC[num_layers]
+    if version == 1:
+        block = BasicBlockV1 if kind == "basic" else BottleneckV1
+        net = ResNetV1(block, layers, channels, **kwargs)
+    else:
+        block = BasicBlockV2 if kind == "basic" else BottleneckV2
+        net = ResNetV2(block, layers, channels, **kwargs)
+    return _maybe_load(net, "resnet%d_v%d" % (num_layers, version),
+                       pretrained, root, ctx)
+
+
+def resnet18_v1(**kw):
+    return _resnet(1, 18, **kw)
+
+
+def resnet34_v1(**kw):
+    return _resnet(1, 34, **kw)
+
+
+def resnet50_v1(**kw):
+    return _resnet(1, 50, **kw)
+
+
+def resnet101_v1(**kw):
+    return _resnet(1, 101, **kw)
+
+
+def resnet152_v1(**kw):
+    return _resnet(1, 152, **kw)
+
+
+def resnet18_v2(**kw):
+    return _resnet(2, 18, **kw)
+
+
+def resnet34_v2(**kw):
+    return _resnet(2, 34, **kw)
+
+
+def resnet50_v2(**kw):
+    return _resnet(2, 50, **kw)
+
+
+def resnet101_v2(**kw):
+    return _resnet(2, 101, **kw)
+
+
+def resnet152_v2(**kw):
+    return _resnet(2, 152, **kw)
+
+
+# ---------------------------------------------------------------------------
+# VGG
+# ---------------------------------------------------------------------------
+
+_VGG_SPEC = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+             13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+             16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+             19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+
+
+class VGG(HybridBlock):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            for i, num in enumerate(layers):
+                for _ in range(num):
+                    self.features.add(nn.Conv2D(filters[i], 3, 1, 1))
+                    if batch_norm:
+                        self.features.add(nn.BatchNorm())
+                    self.features.add(nn.Activation("relu"))
+                self.features.add(nn.MaxPool2D(2, 2))
+            self.features.add(nn.Flatten())
+            self.features.add(nn.Dense(4096, activation="relu"))
+            self.features.add(nn.Dropout(0.5))
+            self.features.add(nn.Dense(4096, activation="relu"))
+            self.features.add(nn.Dropout(0.5))
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def _vgg(num_layers, batch_norm=False, pretrained=False, ctx=None,
+         root="~/.mxnet/models", **kwargs):
+    layers, filters = _VGG_SPEC[num_layers]
+    net = VGG(layers, filters, batch_norm=batch_norm, **kwargs)
+    name = "vgg%d%s" % (num_layers, "_bn" if batch_norm else "")
+    return _maybe_load(net, name, pretrained, root, ctx)
+
+
+def vgg11(**kw):
+    return _vgg(11, **kw)
+
+
+def vgg13(**kw):
+    return _vgg(13, **kw)
+
+
+def vgg16(**kw):
+    return _vgg(16, **kw)
+
+
+def vgg19(**kw):
+    return _vgg(19, **kw)
+
+
+def vgg11_bn(**kw):
+    return _vgg(11, batch_norm=True, **kw)
+
+
+def vgg13_bn(**kw):
+    return _vgg(13, batch_norm=True, **kw)
+
+
+def vgg16_bn(**kw):
+    return _vgg(16, batch_norm=True, **kw)
+
+
+def vgg19_bn(**kw):
+    return _vgg(19, batch_norm=True, **kw)
+
+
+# ---------------------------------------------------------------------------
+# AlexNet / SqueezeNet / DenseNet
+# ---------------------------------------------------------------------------
+
+
+class AlexNet(HybridBlock):
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(nn.Conv2D(64, 11, 4, 2,
+                                        activation="relu"))
+            self.features.add(nn.MaxPool2D(3, 2))
+            self.features.add(nn.Conv2D(192, 5, padding=2,
+                                        activation="relu"))
+            self.features.add(nn.MaxPool2D(3, 2))
+            self.features.add(nn.Conv2D(384, 3, padding=1,
+                                        activation="relu"))
+            self.features.add(nn.Conv2D(256, 3, padding=1,
+                                        activation="relu"))
+            self.features.add(nn.Conv2D(256, 3, padding=1,
+                                        activation="relu"))
+            self.features.add(nn.MaxPool2D(3, 2))
+            self.features.add(nn.Flatten())
+            self.features.add(nn.Dense(4096, activation="relu"))
+            self.features.add(nn.Dropout(0.5))
+            self.features.add(nn.Dense(4096, activation="relu"))
+            self.features.add(nn.Dropout(0.5))
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def alexnet(pretrained=False, ctx=None, root="~/.mxnet/models", **kwargs):
+    return _maybe_load(AlexNet(**kwargs), "alexnet", pretrained, root, ctx)
+
+
+class _Fire(HybridBlock):
+    def __init__(self, squeeze, expand1x1, expand3x3, **kwargs):
+        super().__init__(**kwargs)
+        self.squeeze = nn.Conv2D(squeeze, 1, activation="relu")
+        self.expand1x1 = nn.Conv2D(expand1x1, 1, activation="relu")
+        self.expand3x3 = nn.Conv2D(expand3x3, 3, padding=1,
+                                   activation="relu")
+
+    def hybrid_forward(self, F, x):
+        x = self.squeeze(x)
+        return F.Concat(self.expand1x1(x), self.expand3x3(x), dim=1)
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            if version == "1.0":
+                self.features.add(nn.Conv2D(96, 7, 2, activation="relu"))
+                self.features.add(nn.MaxPool2D(3, 2))
+                self.features.add(_Fire(16, 64, 64))
+                self.features.add(_Fire(16, 64, 64))
+                self.features.add(_Fire(32, 128, 128))
+                self.features.add(nn.MaxPool2D(3, 2))
+                self.features.add(_Fire(32, 128, 128))
+                self.features.add(_Fire(48, 192, 192))
+                self.features.add(_Fire(48, 192, 192))
+                self.features.add(_Fire(64, 256, 256))
+                self.features.add(nn.MaxPool2D(3, 2))
+                self.features.add(_Fire(64, 256, 256))
+            else:
+                self.features.add(nn.Conv2D(64, 3, 2, activation="relu"))
+                self.features.add(nn.MaxPool2D(3, 2))
+                self.features.add(_Fire(16, 64, 64))
+                self.features.add(_Fire(16, 64, 64))
+                self.features.add(nn.MaxPool2D(3, 2))
+                self.features.add(_Fire(32, 128, 128))
+                self.features.add(_Fire(32, 128, 128))
+                self.features.add(nn.MaxPool2D(3, 2))
+                self.features.add(_Fire(48, 192, 192))
+                self.features.add(_Fire(48, 192, 192))
+                self.features.add(_Fire(64, 256, 256))
+                self.features.add(_Fire(64, 256, 256))
+            self.features.add(nn.Dropout(0.5))
+            self.output = nn.HybridSequential(prefix="")
+            self.output.add(nn.Conv2D(classes, 1, activation="relu"))
+            self.output.add(nn.GlobalAvgPool2D())
+            self.output.add(nn.Flatten())
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def squeezenet1_0(pretrained=False, ctx=None, root="~/.mxnet/models",
+                  **kwargs):
+    return _maybe_load(SqueezeNet("1.0", **kwargs), "squeezenet1.0",
+                       pretrained, root, ctx)
+
+
+def squeezenet1_1(pretrained=False, ctx=None, root="~/.mxnet/models",
+                  **kwargs):
+    return _maybe_load(SqueezeNet("1.1", **kwargs), "squeezenet1.1",
+                       pretrained, root, ctx)
+
+
+class _DenseBlock(HybridBlock):
+    def __init__(self, num_layers, growth_rate, bn_size, dropout, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+        for _ in range(num_layers):
+            layer = nn.HybridSequential(prefix="")
+            layer.add(nn.BatchNorm())
+            layer.add(nn.Activation("relu"))
+            layer.add(nn.Conv2D(bn_size * growth_rate, 1, use_bias=False))
+            layer.add(nn.BatchNorm())
+            layer.add(nn.Activation("relu"))
+            layer.add(nn.Conv2D(growth_rate, 3, padding=1,
+                                use_bias=False))
+            if dropout:
+                layer.add(nn.Dropout(dropout))
+            self.register_child(layer)
+            self._layers.append(layer)
+
+    def hybrid_forward(self, F, x):
+        for layer in self._layers:
+            out = layer(x)
+            x = F.Concat(x, out, dim=1)
+        return x
+
+
+def _transition(num_output):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.BatchNorm())
+    out.add(nn.Activation("relu"))
+    out.add(nn.Conv2D(num_output, 1, use_bias=False))
+    out.add(nn.AvgPool2D(2, 2))
+    return out
+
+
+_DENSENET_SPEC = {121: (64, 32, [6, 12, 24, 16]),
+                  161: (96, 48, [6, 12, 36, 24]),
+                  169: (64, 32, [6, 12, 32, 32]),
+                  201: (64, 32, [6, 12, 48, 32])}
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(nn.Conv2D(num_init_features, 7, 2, 3,
+                                        use_bias=False))
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(3, 2, 1))
+            num_features = num_init_features
+            for i, num_layers in enumerate(block_config):
+                self.features.add(_DenseBlock(num_layers, growth_rate,
+                                              bn_size, dropout))
+                num_features += num_layers * growth_rate
+                if i != len(block_config) - 1:
+                    self.features.add(_transition(num_features // 2))
+                    num_features //= 2
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.GlobalAvgPool2D())
+            self.features.add(nn.Flatten())
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def _densenet(num_layers, pretrained=False, ctx=None,
+              root="~/.mxnet/models", **kwargs):
+    init_f, growth, cfg = _DENSENET_SPEC[num_layers]
+    net = DenseNet(init_f, growth, cfg, **kwargs)
+    return _maybe_load(net, "densenet%d" % num_layers, pretrained, root,
+                       ctx)
+
+
+def densenet121(**kw):
+    return _densenet(121, **kw)
+
+
+def densenet161(**kw):
+    return _densenet(161, **kw)
+
+
+def densenet169(**kw):
+    return _densenet(169, **kw)
+
+
+def densenet201(**kw):
+    return _densenet(201, **kw)
+
+
+def mlp_model(classes=10, **kwargs):
+    net = nn.HybridSequential(**kwargs)
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"), nn.Dense(classes))
+    return net
+
+
+_MODELS = {
+    "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
+    "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
+    "resnet152_v1": resnet152_v1, "resnet18_v2": resnet18_v2,
+    "resnet34_v2": resnet34_v2, "resnet50_v2": resnet50_v2,
+    "resnet101_v2": resnet101_v2, "resnet152_v2": resnet152_v2,
+    "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+    "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn,
+    "vgg19_bn": vgg19_bn, "alexnet": alexnet,
+    "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+}
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    if name not in _MODELS:
+        raise MXNetError("model %s not in zoo; available: %s"
+                         % (name, sorted(_MODELS)))
+    return _MODELS[name](**kwargs)

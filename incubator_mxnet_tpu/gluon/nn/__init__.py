@@ -1,0 +1,15 @@
+"""Gluon neural-network layers (``python/mxnet/gluon/nn/``)."""
+from .basic_layers import (Sequential, HybridSequential, Dense, Activation,
+                           Dropout, BatchNorm, LeakyReLU, Embedding,
+                           Flatten, Lambda, HybridLambda)
+from .conv_layers import (Conv1D, Conv2D, Conv3D, Conv2DTranspose,
+                          MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D,
+                          AvgPool2D, AvgPool3D, GlobalMaxPool2D,
+                          GlobalAvgPool2D, GlobalAvgPool1D, GlobalMaxPool1D)
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Activation",
+           "Dropout", "BatchNorm", "LeakyReLU", "Embedding", "Flatten",
+           "Lambda", "HybridLambda", "Conv1D", "Conv2D", "Conv3D",
+           "Conv2DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool2D",
+           "GlobalAvgPool2D", "GlobalAvgPool1D", "GlobalMaxPool1D"]

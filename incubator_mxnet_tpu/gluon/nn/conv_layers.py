@@ -1,0 +1,191 @@
+"""Gluon convolution / pooling layers
+(``python/mxnet/gluon/nn/conv_layers.py``)."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from .basic_layers import Activation
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose", "MaxPool1D",
+           "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D", "AvgPool3D",
+           "GlobalMaxPool2D", "GlobalAvgPool2D", "GlobalAvgPool1D",
+           "GlobalMaxPool1D"]
+
+
+def _pair(x, n):
+    if isinstance(x, (tuple, list)):
+        return tuple(x)
+    return (x,) * n
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, in_channels, activation, use_bias,
+                 weight_initializer, bias_initializer, op_name, ndim,
+                 op_extra=None, **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        kernel_size = _pair(kernel_size, ndim)
+        strides = _pair(strides, ndim)
+        padding = _pair(padding, ndim)
+        dilation = _pair(dilation, ndim)
+        self._op_name = op_name
+        self._kwargs = {"kernel": kernel_size, "stride": strides,
+                        "pad": padding, "dilate": dilation,
+                        "num_filter": channels, "num_group": groups,
+                        "no_bias": not use_bias}
+        if op_extra:
+            self._kwargs.update(op_extra)
+        if op_name == "Deconvolution":
+            wshape = (in_channels, channels // groups) + kernel_size
+        else:
+            wshape = (channels, in_channels // max(groups, 1)) \
+                + kernel_size if in_channels else \
+                (channels, 0) + kernel_size
+        with self.name_scope():
+            self.weight = self.params.get("weight", shape=wshape,
+                                          init=weight_initializer,
+                                          allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get("bias", shape=(channels,),
+                                            init=bias_initializer,
+                                            allow_deferred_init=True)
+            else:
+                self.bias = None
+            self.act = Activation(activation, prefix=activation + "_") \
+                if activation else None
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        op = getattr(F, self._op_name)
+        if bias is None:
+            out = op(x, weight, **self._kwargs)
+        else:
+            kw = dict(self._kwargs, no_bias=False)
+            out = op(x, weight, bias, **kw)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zero", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         "Convolution", 1, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1, in_channels=0,
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zero", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         "Convolution", 2, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zero",
+                 **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         "Convolution", 3, **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1),
+                 padding=(0, 0), output_padding=(0, 0), dilation=(1, 1),
+                 groups=1, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zero",
+                 **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         "Deconvolution", 2,
+                         op_extra={"adj": _pair(output_padding, 2)},
+                         **kwargs)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ndim, global_pool,
+                 pool_type, **kwargs):
+        super().__init__(**kwargs)
+        if strides is None:
+            strides = pool_size
+        self._kwargs = {"kernel": _pair(pool_size, ndim),
+                        "stride": _pair(strides, ndim),
+                        "pad": _pair(padding, ndim),
+                        "global_pool": global_pool,
+                        "pool_type": pool_type}
+
+    def _alias(self):
+        return "pool"
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, **self._kwargs)
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, **kwargs):
+        super().__init__(pool_size, strides, padding, 1, False, "max",
+                         **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, **kwargs):
+        super().__init__(pool_size, strides, padding, 2, False, "max",
+                         **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 **kwargs):
+        super().__init__(pool_size, strides, padding, 3, False, "max",
+                         **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, **kwargs):
+        super().__init__(pool_size, strides, padding, 1, False, "avg",
+                         **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, **kwargs):
+        super().__init__(pool_size, strides, padding, 2, False, "avg",
+                         **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 **kwargs):
+        super().__init__(pool_size, strides, padding, 3, False, "avg",
+                         **kwargs)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, **kwargs):
+        super().__init__((1,), None, 0, 1, True, "max", **kwargs)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, **kwargs):
+        super().__init__((1,), None, 0, 1, True, "avg", **kwargs)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, **kwargs):
+        super().__init__((1, 1), None, 0, 2, True, "max", **kwargs)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, **kwargs):
+        super().__init__((1, 1), None, 0, 2, True, "avg", **kwargs)

@@ -1,0 +1,306 @@
+"""Gluon Parameter / ParameterDict
+(``python/mxnet/gluon/parameter.py:41,367``): deferred shape init, per-ctx
+replicas, grad buffers, symbol bridging via ``var()``."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import autograd, initializer as init_mod, symbol as sym_mod
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray import zeros as nd_zeros
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Parameter", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter shape unknown until first forward."""
+
+
+class Parameter:
+    def __init__(self, name: str, grad_req: str = "write", shape=None,
+                 dtype=np.float32, lr_mult: float = 1.0,
+                 wd_mult: float = 1.0, init=None,
+                 allow_deferred_init: bool = False,
+                 differentiable: bool = True):
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        if not differentiable:
+            grad_req = "null"
+        self.grad_req = grad_req
+        self._var = None
+        self._data: Optional[Dict[Context, NDArray]] = None
+        self._grad: Optional[Dict[Context, NDArray]] = None
+        self._deferred_init = ()
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self.shape,
+                                                      self.dtype)
+
+    # ------------------------------------------------------------------ init
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit: bool = False) -> None:
+        default_init = default_init or init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = self.init if self.init is not None else default_init
+        if self.shape is None or any(s == 0 for s in self.shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError("cannot initialize %s: shape unknown" %
+                             self.name)
+        self._finish_init(init, ctx)
+
+    def _finish_init(self, init, ctx_list) -> None:
+        data = nd_zeros(self.shape, dtype=self.dtype)
+        initializer = init_mod.create(init) if isinstance(init, str) \
+            else init
+        initializer(init_mod.InitDesc(self.name), data)
+        self._data = {}
+        self._grad = {} if self.grad_req != "null" else None
+        for c in ctx_list:
+            self._data[c] = data.copyto(c)
+            if self._grad is not None:
+                g = nd_zeros(self.shape, ctx=c, dtype=self.dtype)
+                self._data[c].grad = g
+                self._data[c]._grad_req = self.grad_req
+                autograd.mark_variables([self._data[c]], [g],
+                                        self.grad_req)
+                self._grad[c] = g
+        self._deferred_init = ()
+
+    def _finish_deferred_init(self, shape) -> None:
+        if not self._deferred_init:
+            raise DeferredInitializationError(
+                "parameter %s not initialized" % self.name)
+        self.shape = tuple(shape)
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init if init is not None else default_init, ctx)
+
+    def _check_initialized(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init:
+                raise DeferredInitializationError(
+                    "parameter %s deferred" % self.name)
+            raise MXNetError(
+                "parameter %s not initialized; call initialize()"
+                % self.name)
+
+    # ------------------------------------------------------------------ data
+    def _ctx_key(self, ctx):
+        ctx = ctx or current_context()
+        if ctx in self._data:
+            return ctx
+        if len(self._data) == 1:
+            return next(iter(self._data))
+        raise MXNetError("parameter %s not on context %s" % (self.name, ctx))
+
+    def data(self, ctx=None) -> NDArray:
+        self._check_initialized(ctx)
+        return self._data[self._ctx_key(ctx)]
+
+    def list_data(self) -> List[NDArray]:
+        self._check_initialized()
+        return list(self._data.values())
+
+    def grad(self, ctx=None) -> NDArray:
+        self._check_initialized(ctx)
+        if self._grad is None:
+            raise MXNetError("parameter %s has grad_req=null" % self.name)
+        return self._grad[self._ctx_key(ctx)]
+
+    def list_grad(self) -> List[NDArray]:
+        self._check_initialized()
+        if self._grad is None:
+            raise MXNetError("parameter %s has grad_req=null" % self.name)
+        return list(self._grad.values())
+
+    def list_ctx(self) -> List[Context]:
+        if self._data is None and self._deferred_init:
+            # deferred params know their target ctx before materializing
+            return list(self._deferred_init[1])
+        self._check_initialized()
+        return list(self._data.keys())
+
+    def set_data(self, data) -> None:
+        self._check_initialized()
+        for c, arr in self._data.items():
+            if isinstance(data, NDArray):
+                arr._set_data(data.data.astype(arr.dtype))
+            else:
+                arr[:] = np.asarray(data)
+
+    def zero_grad(self) -> None:
+        if self._grad is None:
+            return
+        for g in self._grad.values():
+            g[:] = 0.0
+
+    def reset_ctx(self, ctx) -> None:
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is not None:
+            data = self._reduce()
+            init_ctx = ctx
+            self._data = None
+            self._grad = None
+            self.initialize(ctx=init_ctx, init=init_mod.Constant(0.0))
+            self.set_data(data)
+
+    def _reduce(self) -> NDArray:
+        """Average over ctx replicas (gradient-sync safety)."""
+        self._check_initialized()
+        vals = list(self._data.values())
+        if len(vals) == 1:
+            return vals[0].copy()
+        acc = vals[0].copyto(cpu())
+        for v in vals[1:]:
+            acc += v.copyto(cpu())
+        return acc / len(vals)
+
+    # ---------------------------------------------------------------- symbol
+    def var(self):
+        if self._var is None:
+            self._var = sym_mod.Variable(
+                self.name, shape=self.shape, dtype=self.dtype,
+                lr_mult=self.lr_mult, wd_mult=self.wd_mult)
+        return self._var
+
+    def cast(self, dtype) -> None:
+        self.dtype = dtype
+        if self._data is None:
+            return
+        self._data = {c: v.astype(dtype) for c, v in self._data.items()}
+        if self._grad is not None:
+            new_grad = {c: g.astype(dtype) for c, g in self._grad.items()}
+            for c in self._data:
+                autograd.mark_variables([self._data[c]], [new_grad[c]],
+                                        self.grad_req)
+            self._grad = new_grad
+
+
+class ParameterDict:
+    def __init__(self, prefix: str = "", shared: "ParameterDict" = None):
+        self._prefix = prefix
+        self._params: Dict[str, Parameter] = {}
+        self._shared = shared
+
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    def __repr__(self):
+        return "ParameterDict '%s' (%s)" % (
+            self._prefix, ", ".join(self._params))
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __getitem__(self, key) -> Parameter:
+        return self._params[key]
+
+    def __contains__(self, key) -> bool:
+        return key in self._params
+
+    def get(self, name: str, **kwargs) -> Parameter:
+        """Create-or-retrieve ``prefix+name``
+        (reference ``ParameterDict.get``)."""
+        name = self._prefix + name
+        if name in self._params:
+            param = self._params[name]
+            for k, v in kwargs.items():
+                if getattr(param, k, None) is not None and v is not None \
+                        and k == "shape":
+                    if tuple(v) != tuple(param.shape or v):
+                        raise MXNetError("shape mismatch for %s" % name)
+            return param
+        if self._shared is not None and name in self._shared:
+            self._params[name] = self._shared[name]
+            return self._params[name]
+        param = Parameter(name, **kwargs)
+        self._params[name] = param
+        return param
+
+    def update(self, other: "ParameterDict") -> None:
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError("duplicate parameter %s" % k)
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False) -> None:
+        for p in self.values():
+            p.initialize(init=None, ctx=ctx,
+                         default_init=init or init_mod.Uniform(),
+                         force_reinit=force_reinit)
+
+    def zero_grad(self) -> None:
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx) -> None:
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value) -> None:
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, fname: str, strip_prefix: str = "") -> None:
+        from ..ndarray import save as nd_save
+
+        arg = {}
+        for p in self.values():
+            weight = p._reduce()
+            name = p.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg[name] = weight
+        nd_save(fname, arg)
+
+    def load(self, fname: str, ctx=None, allow_missing: bool = False,
+             ignore_extra: bool = False, restore_prefix: str = "") -> None:
+        from ..ndarray import load as nd_load
+
+        loaded = nd_load(fname)
+        loaded = {(restore_prefix + k.split(":", 1)[-1]): v
+                  for k, v in loaded.items()}
+        for name, p in self.items():
+            if name not in loaded:
+                if not allow_missing:
+                    raise MXNetError("parameter %s missing in file" % name)
+                continue
+            if p._data is None and not p._deferred_init:
+                p.shape = tuple(loaded[name].shape)
+                p.initialize(ctx=ctx)
+            elif p._deferred_init:
+                p._finish_deferred_init(loaded[name].shape)
+            p.set_data(loaded[name])
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise MXNetError("extra parameters in file: %s"
+                                 % sorted(extra))

@@ -1,0 +1,379 @@
+"""Gluon recurrent cells (``python/mxnet/gluon/rnn/rnn_cell.py``)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as nd
+
+        func = func or nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            info = dict(info)
+            info.update(kwargs)
+            states.append(func(name="%sbegin_state_%d"
+                               % (self._prefix, self._init_counter),
+                               **info))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll over `length` steps (symbolic unrolling ≙ the reference;
+        under jit XLA rolls this back into an efficient loop)."""
+        from ... import ndarray as nd
+
+        self.reset()
+        axis = layout.find("T")
+        batch_size = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size,
+                                           ctx=inputs.context)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            step = nd.slice_axis(inputs, axis=axis, begin=i, end=i + 1)
+            step = nd.Reshape(step, shape=tuple(
+                s for j, s in enumerate(step.shape) if j != axis))
+            output, states = self(step, states)
+            outputs.append(output)
+        if merge_outputs is None or merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        return self.forward(inputs, states)
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zero", h2h_bias_initializer="zero",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        output = F.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+    def forward(self, inputs, states):
+        from ... import ndarray as nd
+
+        params = {k: self._param_data(p, inputs)
+                  for k, p in self._reg_params.items()}
+        return self.hybrid_forward(nd, inputs, states, **params)
+
+    def _param_data(self, p, inputs):
+        from ..parameter import DeferredInitializationError
+
+        try:
+            return p.data(inputs.context)
+        except DeferredInitializationError:
+            if p.name.endswith("i2h_weight"):
+                p._finish_deferred_init((self._hidden_size * self._gate_mult(),
+                                         inputs.shape[-1]))
+            else:
+                raise
+            return p.data(inputs.context)
+
+    def _gate_mult(self):
+        return 1
+
+
+class LSTMCell(RNNCell):
+    def __init__(self, hidden_size, input_size=0, prefix=None, params=None,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zero", h2h_bias_initializer="zero"):
+        RecurrentCell.__init__(self, prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def _gate_mult(self):
+        return 4
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        slices = F.SliceChannel(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(slices[0])
+        forget_gate = F.sigmoid(slices[1])
+        in_transform = F.tanh(slices[2])
+        out_gate = F.sigmoid(slices[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * F.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(RNNCell):
+    def __init__(self, hidden_size, input_size=0, prefix=None, params=None,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zero", h2h_bias_initializer="zero"):
+        RecurrentCell.__init__(self, prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(3 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(3 * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(3 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(3 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def _gate_mult(self):
+        return 3
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev_h = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(prev_h, h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i2h_s = F.SliceChannel(i2h, num_outputs=3, axis=1)
+        h2h_s = F.SliceChannel(h2h, num_outputs=3, axis=1)
+        reset_gate = F.sigmoid(i2h_s[0] + h2h_s[0])
+        update_gate = F.sigmoid(i2h_s[1] + h2h_s[1])
+        next_h_tmp = F.tanh(i2h_s[2] + reset_gate * h2h_s[2])
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for cell in self._children:
+            out.extend(cell.state_info(batch_size))
+        return out
+
+    def begin_state(self, batch_size=0, **kwargs):
+        out = []
+        for cell in self._children:
+            out.extend(cell.begin_state(batch_size, **kwargs))
+        return out
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children:
+            n = len(cell.state_info())
+            inputs, st = cell(inputs, states[p:p + n])
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+    def forward(self, inputs, states):
+        return self.__call__(inputs, states)
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell.prefix + self._alias() + "_",
+                         params=None)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return "dropout"
+
+    def forward(self, inputs, states):
+        from ... import ndarray as nd
+
+        if self._rate > 0:
+            inputs = nd.Dropout(inputs, p=self._rate)
+        return inputs, states
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def forward(self, inputs, states):
+        from ... import autograd as ag
+        from ... import ndarray as nd
+
+        next_output, next_states = self.base_cell(inputs, states)
+        if not ag.is_training():
+            return next_output, next_states
+        po, ps = self._zoneout_outputs, self._zoneout_states
+
+        def mask(p, like):
+            return nd.Dropout(nd.ones_like(like), p=p)
+
+        prev = self._prev_output if self._prev_output is not None \
+            else nd.zeros_like(next_output)
+        if po:
+            m = mask(po, next_output)
+            output = nd.where(m, next_output, prev)
+        else:
+            output = next_output
+        if ps:
+            states_out = [nd.where(mask(ps, ns), ns, s)
+                          for ns, s in zip(next_states, states)]
+        else:
+            states_out = next_states
+        self._prev_output = output
+        return output, states_out
+
+
+class ResidualCell(ModifierCell):
+    def _alias(self):
+        return "residual"
+
+    def forward(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell)
+        self.register_child(r_cell)
+        self._output_prefix = output_prefix
+
+    def state_info(self, batch_size=0):
+        out = []
+        for cell in self._children:
+            out.extend(cell.state_info(batch_size))
+        return out
+
+    def begin_state(self, batch_size=0, **kwargs):
+        out = []
+        for cell in self._children:
+            out.extend(cell.begin_state(batch_size, **kwargs))
+        return out
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell supports only unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        from ... import ndarray as nd
+
+        self.reset()
+        axis = layout.find("T")
+        batch_size = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size,
+                                           ctx=inputs.context)
+        l_cell, r_cell = self._children
+        n_l = len(l_cell.state_info())
+        l_out, l_states = l_cell.unroll(length, inputs,
+                                        begin_state[:n_l], layout)
+        rev = nd.reverse(inputs, axis=(axis,))
+        r_out, r_states = r_cell.unroll(length, rev, begin_state[n_l:],
+                                        layout)
+        r_out = nd.reverse(r_out, axis=(axis,))
+        outputs = nd.Concat(l_out, r_out, dim=2 if layout == "NTC" else 2)
+        return outputs, l_states + r_states

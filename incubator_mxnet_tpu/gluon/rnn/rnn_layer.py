@@ -1,0 +1,158 @@
+"""Gluon fused RNN layers (``python/mxnet/gluon/rnn/rnn_layer.py``): RNN /
+LSTM / GRU over the fused scan-based ``RNN`` op (ops/rnn_ops.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ..block import Block
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(Block):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError("layout must be TNC or NTC")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        self._gates = gates
+
+        self._params_per = []
+        ng = gates * hidden_size
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 \
+                else hidden_size * self._dir
+            for direction in (["l", "r"] if bidirectional else ["l"]):
+                self._params_per.append((
+                    self.params.get(
+                        "%s%d_i2h_weight" % (direction, layer),
+                        shape=(ng, in_size),
+                        init=i2h_weight_initializer,
+                        allow_deferred_init=True),
+                    self.params.get(
+                        "%s%d_h2h_weight" % (direction, layer),
+                        shape=(ng, hidden_size),
+                        init=h2h_weight_initializer,
+                        allow_deferred_init=True),
+                    self.params.get(
+                        "%s%d_i2h_bias" % (direction, layer),
+                        shape=(ng,), init=i2h_bias_initializer,
+                        allow_deferred_init=True),
+                    self.params.get(
+                        "%s%d_h2h_bias" % (direction, layer),
+                        shape=(ng,), init=h2h_bias_initializer,
+                        allow_deferred_init=True)))
+
+    def state_info(self, batch_size=0):
+        n = self._num_layers * self._dir
+        info = [{"shape": (n, batch_size, self._hidden_size),
+                 "__layout__": "LNC"}]
+        if self._mode == "lstm":
+            info.append({"shape": (n, batch_size, self._hidden_size),
+                         "__layout__": "LNC"})
+        return info
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as nd
+
+        func = func or nd.zeros
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            info = dict(info)
+            info.update(kwargs)
+            info.pop("__layout__", None)
+            states.append(func(shape=info.pop("shape"), **info))
+        return states
+
+    def _finish_params(self, input_size):
+        for i, tup in enumerate(self._params_per):
+            layer = i // self._dir
+            in_size = input_size if layer == 0 \
+                else self._hidden_size * self._dir
+            ng = self._gates * self._hidden_size
+            shapes = [(ng, in_size), (ng, self._hidden_size), (ng,), (ng,)]
+            for p, s in zip(tup, shapes):
+                if p._deferred_init:
+                    p._finish_deferred_init(s)
+
+    def forward(self, inputs, states=None):
+        from ... import ndarray as nd
+
+        if self._layout == "NTC":
+            inputs = nd.swapaxes(inputs, dim1=0, dim2=1)
+        T, N, I = inputs.shape
+        self._finish_params(I)
+        skip_states = states is None
+        if states is None:
+            states = self.begin_state(N, ctx=inputs.context)
+        # pack via recorded ops (Reshape+Concat) so autograd routes RNN
+        # param grads back to each Parameter's grad buffer
+        ctx = inputs.context
+        flats = [tup[i].data(ctx).reshape((-1,))
+                 for tup in self._params_per for i in (0, 1)]
+        flats += [tup[i].data(ctx) for tup in self._params_per
+                  for i in (2, 3)]
+        params_nd = nd.Concat(*flats, dim=0)
+        args = [inputs, params_nd] + list(states)
+        outs = nd.RNN(*args, mode=self._mode,
+                      state_size=self._hidden_size,
+                      num_layers=self._num_layers,
+                      bidirectional=self._dir == 2,
+                      p=self._dropout, state_outputs=True)
+        out = outs[0]
+        out_states = list(outs[1:])
+        if self._layout == "NTC":
+            out = nd.swapaxes(out, dim1=0, dim2=1)
+        if skip_states:
+            return out
+        return out, out_states
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zero", h2h_bias_initializer="zero",
+                 input_size=0, **kwargs):
+        super().__init__("rnn_" + activation, hidden_size, num_layers,
+                         layout, dropout, bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zero", h2h_bias_initializer="zero",
+                 **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zero", h2h_bias_initializer="zero",
+                 **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         **kwargs)

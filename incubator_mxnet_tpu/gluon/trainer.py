@@ -1,0 +1,119 @@
+"""Gluon Trainer (``python/mxnet/gluon/trainer.py:26``): kvstore-backed
+parameter updates over Parameter grad buffers."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import kvstore as kvs, optimizer as opt_mod
+from ..base import MXNetError
+from ..model import _create_kvstore
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be list/dict of Parameters")
+        self._params = []
+        for p in params:
+            if not isinstance(p, Parameter):
+                raise MXNetError("non-Parameter in Trainer params")
+            if p.grad_req != "null":
+                self._params.append(p)
+        optimizer_params = dict(optimizer_params or {})
+        self._scale = optimizer_params.get("rescale_grad", 1.0)
+        self._contexts = self._check_contexts()
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_initialized = False
+        self._kvstore_arg = kvstore
+
+    def _check_contexts(self):
+        contexts = None
+        for p in self._params:
+            ctx = p.list_ctx()
+            if contexts is not None and contexts != ctx:
+                raise MXNetError("all Parameters must share contexts")
+            contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params:
+                raise MXNetError(
+                    "optimizer_params must be None for Optimizer instance")
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt_mod.create(optimizer,
+                                             param_idx2name={
+                                                 i: p.name for i, p in
+                                                 param_dict.items()},
+                                             **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)
+                          for _ in self._contexts]
+
+    def _init_kvstore(self):
+        arg_arrays = {p.name: p.data(self._contexts[0])
+                      for p in self._params}
+        kvstore, update_on_kvstore = _create_kvstore(
+            self._kvstore_arg, len(self._contexts), arg_arrays)
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        if kvstore:
+            for i, param in enumerate(self._params):
+                kvstore.init(i, param.data(self._contexts[0]))
+                if update_on_kvstore:
+                    kvstore.pull(i, param.list_data(), priority=-i)
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr
+
+    def set_learning_rate(self, lr: float):
+        self._optimizer.lr = lr
+
+    def step(self, batch_size: int, ignore_stale_grad: bool = False):
+        """Aggregate grads across ctxs, update weights
+        (reference ``trainer.py:116``)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if self._kvstore:
+                self._kvstore.push(i, param.list_grad(), priority=-i)
+                if self._update_on_kvstore:
+                    self._kvstore.pull(i, param.list_data(), priority=-i)
+                    continue
+                self._kvstore.pull(i, param.list_grad(), priority=-i)
+            for upd, arr, grad in zip(self._updaters, param.list_data(),
+                                      param.list_grad()):
+                upd(i, grad, arr)
+
+    def save_states(self, fname: str):
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updaters[0].get_states())
+
+    def load_states(self, fname: str):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                data = f.read()
+            for upd in self._updaters:
+                upd.set_states(data)

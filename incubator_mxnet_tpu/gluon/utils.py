@@ -1,0 +1,63 @@
+"""Gluon utilities (``python/mxnet/gluon/utils.py``): split_and_load,
+split_data, clip_global_norm."""
+from __future__ import annotations
+
+import math
+from typing import List
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray import array as nd_array
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm"]
+
+
+def split_data(data, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise MXNetError("batch size %d < num_slice %d" % (size, num_slice))
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            "data of shape %s cannot be evenly split into %d slices"
+            % (data.shape, num_slice))
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * len(data.shape)
+        idx[batch_axis] = slice(begin, end)
+        slices.append(NDArray(data.data[tuple(idx)], ctx=data._ctx))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis: int = 0,
+                   even_split: bool = True) -> List[NDArray]:
+    if not isinstance(data, NDArray):
+        data = nd_array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm: float) -> float:
+    """Rescale arrays so total L2 norm ≤ max_norm; returns the norm."""
+    assert len(arrays) > 0
+    total = 0.0
+    for arr in arrays:
+        total += float((arr * arr).sum().asscalar())
+    total = math.sqrt(total)
+    if not np.isfinite(total):
+        import warnings
+
+        warnings.warn("nan or inf found in gradients")
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total

@@ -138,8 +138,8 @@ class DataParallelExecutorGroup:
         for i, (ex, sl) in enumerate(zip(self.execs, self.slices)):
             og = None
             if out_grads is not None:
-                og = [g[sl] if isinstance(g, NDArray) else g
-                      for g in out_grads]
+                og = [g[sl] if isinstance(g, NDArray)
+                      else np.asarray(g)[sl] for g in out_grads]
             ex.backward(out_grads=og)
 
     def get_outputs(self, merge_multi_context: bool = True):
@@ -183,8 +183,8 @@ class DataParallelExecutorGroup:
 
     def update_metric(self, eval_metric, labels) -> None:
         for ex, sl in zip(self.execs, self.slices):
-            labels_slice = [l[sl] if isinstance(l, NDArray) else l
-                            for l in labels]
+            labels_slice = [l[sl] if isinstance(l, NDArray)
+                            else np.asarray(l)[sl] for l in labels]
             eval_metric.update(labels_slice, ex.outputs)
 
     def install_monitor(self, mon) -> None:

@@ -330,6 +330,9 @@ class Module(BaseModule):
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
+        # pull live device weights back before rebinding, else the rebound
+        # executors would restart from the stale host-side copies
+        self._sync_params_from_devices()
         self.bind(data_shapes, label_shapes, self.for_training,
                   self.inputs_need_grad, force_rebind=True)
         if self.params_initialized:

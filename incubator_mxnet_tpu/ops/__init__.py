@@ -27,5 +27,6 @@ from . import ordering  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import sequence  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
 from . import linalg  # noqa: F401
 from . import contrib_ops  # noqa: F401

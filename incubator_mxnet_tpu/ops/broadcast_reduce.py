@@ -105,3 +105,8 @@ def _broadcast_axis(ins, attrs, ctx):
 @register("broadcast_like", arg_names=["lhs", "rhs"])
 def _broadcast_like(ins, attrs, ctx):
     return jnp.broadcast_to(ins[0], ins[1].shape)
+
+
+@register("reshape_like", arg_names=["lhs", "rhs"])
+def _reshape_like(ins, attrs, ctx):
+    return ins[0].reshape(ins[1].shape)

@@ -93,6 +93,23 @@ def _scatter_set_nd(ins, attrs, ctx):
     return lhs.at[tuple(idx[i] for i in range(m))].set(rhs)
 
 
+@register("pick", arg_names=["data", "index"])
+def _pick(ins, attrs, ctx):
+    """Pick per-row elements along an axis
+    (``src/operator/tensor/broadcast_reduce_op.h`` pick)."""
+    data, index = ins
+    axis = parse_int(attrs.get("axis"), -1)
+    keepdims = parse_bool(attrs.get("keepdims", False))
+    ax = axis % data.ndim
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[ax] - 1)
+    idx_exp = jnp.expand_dims(idx.reshape(
+        tuple(data.shape[i] for i in range(data.ndim) if i != ax)), ax)
+    out = jnp.take_along_axis(data, idx_exp, axis=ax)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=ax)
+    return out
+
+
 @register("where", arg_names=["condition", "x", "y"])
 def _where(ins, attrs, ctx):
     """``src/operator/tensor/control_flow_op.h`` where: condition may be

@@ -1,0 +1,228 @@
+"""Fused RNN op.
+
+Reference analog: the ``RNN`` operator (``src/operator/rnn-inl.h``) — in the
+reference it is cuDNN-only (CPU ``CreateOperator`` is ``LOG(FATAL) << "Not
+Implemented"``, rnn-inl.h:319; GPU at rnn.cu:29).  TPU-native redesign: one
+``lax.scan`` per layer with the input projection hoisted out of the loop
+(one big (T·N, I)×(I, G·H) matmul feeds the MXU; the scan body only does the
+recurrent (N, H)×(H, G·H) matmul) — XLA compiles the whole stack into a
+single fused loop.  Parameters use the cuDNN flat-vector packing the
+reference exposes (all gate weights per layer/direction, then all biases),
+so ``mx.sym.RNN`` checkpoints stay layout-compatible.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, parse_bool, parse_float, parse_int
+
+__all__ = ["rnn_param_size", "rnn_pack_weights", "rnn_unpack_weights"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _layer_shapes(mode, num_layers, input_size, hidden, bidirectional):
+    """Yield (W_i shape, W_h shape, b shape×2) per (layer, direction)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else hidden * d
+        for _ in range(d):
+            yield ((g * hidden, in_size), (g * hidden, hidden),
+                   (g * hidden,), (g * hidden,))
+
+
+def rnn_param_size(mode, num_layers, input_size, hidden,
+                   bidirectional=False) -> int:
+    total = 0
+    for wi, wh, bi, bh in _layer_shapes(mode, num_layers, input_size,
+                                        hidden, bidirectional):
+        total += int(np.prod(wi)) + int(np.prod(wh)) + bi[0] + bh[0]
+    return total
+
+
+def rnn_unpack_weights(params, mode, num_layers, input_size, hidden,
+                       bidirectional=False):
+    """Flat vector → list of (W_i, W_h, b_i, b_h) per (layer, direction);
+    cuDNN order: all weights first, then all biases."""
+    shapes = list(_layer_shapes(mode, num_layers, input_size, hidden,
+                                bidirectional))
+    out = []
+    pos = 0
+    ws = []
+    for wi, wh, _, _ in shapes:
+        n = int(np.prod(wi))
+        ws.append(params[pos:pos + n].reshape(wi))
+        pos += n
+        n = int(np.prod(wh))
+        ws.append(params[pos:pos + n].reshape(wh))
+        pos += n
+    bs = []
+    for _, _, bi, bh in shapes:
+        bs.append(params[pos:pos + bi[0]])
+        pos += bi[0]
+        bs.append(params[pos:pos + bh[0]])
+        pos += bh[0]
+    for i in range(len(shapes)):
+        out.append((ws[2 * i], ws[2 * i + 1], bs[2 * i], bs[2 * i + 1]))
+    return out
+
+
+def rnn_pack_weights(weights, mode=None):
+    """Inverse of unpack: list of (W_i, W_h, b_i, b_h) → flat vector."""
+    flat = [w for tup in weights for w in (tup[0].reshape(-1),
+                                           tup[1].reshape(-1))]
+    flat += [b for tup in weights for b in (tup[2], tup[3])]
+    return jnp.concatenate(flat)
+
+
+def _cell_step(mode, hidden):
+    # NB: only b_i is hoisted into the input projection; b_h is applied
+    # inside the step because cuDNN GRU places b_hn INSIDE the reset-gate
+    # product: n = tanh(nx + b_in + r·(nh + b_hn))
+    if mode in ("rnn_relu", "rnn_tanh"):
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+
+        def step(carry, gates_x, wh, bh):
+            (h,) = carry
+            g = gates_x + jnp.matmul(h, wh.T) + bh
+            h2 = act(g)
+            return (h2,), h2
+
+        return step, 1
+    if mode == "lstm":
+        def step(carry, gates_x, wh, bh):
+            h, c = carry
+            g = gates_x + jnp.matmul(h, wh.T) + bh
+            i, f, gg, o = jnp.split(g, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            gg = jnp.tanh(gg)
+            o = jax.nn.sigmoid(o)
+            c2 = f * c + i * gg
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+
+        return step, 2
+    if mode == "gru":
+        def step(carry, gates_x, wh, bh):
+            (h,) = carry
+            rx, zx, nx = jnp.split(gates_x, 3, axis=-1)
+            gh = jnp.matmul(h, wh.T) + bh
+            rh, zh, nh = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(rx + rh)
+            z = jax.nn.sigmoid(zx + zh)
+            n = jnp.tanh(nx + r * nh)
+            h2 = (1 - z) * n + z * h
+            return (h2,), h2
+
+        return step, 1
+    raise ValueError("unknown RNN mode %s" % mode)
+
+
+def _run_direction(x, wi, wh, bi, bh, h0, c0, mode, hidden, reverse):
+    """One (layer, direction) scan.  x: (T, N, I)."""
+    step, n_state = _cell_step(mode, hidden)
+    T, N, _ = x.shape
+    # hoist the input projection out of the recurrence → one MXU matmul
+    gates_x = jnp.matmul(x.reshape(T * N, -1), wi.T).reshape(T, N, -1) + bi
+    if reverse:
+        gates_x = jnp.flip(gates_x, axis=0)
+    carry0 = (h0,) if n_state == 1 else (h0, c0)
+
+    def body(carry, gx):
+        return step(carry, gx, wh, bh)
+
+    carry, ys = jax.lax.scan(body, carry0, gates_x)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, carry
+
+
+def _rnn_impl(data, params, state_h, state_c, attrs, ctx):
+    mode = attrs.get("mode", "lstm")
+    hidden = parse_int(attrs.get("state_size"))
+    num_layers = parse_int(attrs.get("num_layers"), 1)
+    bidirectional = parse_bool(attrs.get("bidirectional", False))
+    p_drop = parse_float(attrs.get("p", 0.0))
+    d = 2 if bidirectional else 1
+    input_size = data.shape[2]
+
+    weights = rnn_unpack_weights(params, mode, num_layers, input_size,
+                                 hidden, bidirectional)
+    x = data
+    out_h, out_c = [], []
+    for layer in range(num_layers):
+        ys = []
+        for direction in range(d):
+            idx = layer * d + direction
+            wi, wh, bi, bh = weights[idx]
+            h0 = state_h[idx]
+            c0 = state_c[idx] if state_c is not None else None
+            y, carry = _run_direction(x, wi, wh, bi, bh, h0, c0, mode,
+                                      hidden, reverse=(direction == 1))
+            ys.append(y)
+            out_h.append(carry[0])
+            if len(carry) > 1:
+                out_c.append(carry[1])
+        x = ys[0] if d == 1 else jnp.concatenate(ys, axis=-1)
+        if p_drop > 0 and ctx.is_train and ctx.rng is not None \
+                and layer < num_layers - 1:
+            keep = 1.0 - p_drop
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(ctx.rng, layer), keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0)
+    return x, jnp.stack(out_h), (jnp.stack(out_c) if out_c else None)
+
+
+def _rnn_args(attrs):
+    if attrs.get("mode", "lstm") == "lstm":
+        return ["data", "parameters", "state", "state_cell"]
+    return ["data", "parameters", "state"]
+
+
+def _rnn_infer_shape(in_shapes, attrs):
+    mode = attrs.get("mode", "lstm")
+    hidden = parse_int(attrs.get("state_size"))
+    num_layers = parse_int(attrs.get("num_layers"), 1)
+    bidirectional = parse_bool(attrs.get("bidirectional", False))
+    state_outputs = parse_bool(attrs.get("state_outputs", False))
+    d = 2 if bidirectional else 1
+    data_s = in_shapes[0]
+    if data_s is None:
+        return in_shapes, [None], []
+    T, N, I = data_s
+    pshape = (rnn_param_size(mode, num_layers, I, hidden, bidirectional),)
+    sshape = (num_layers * d, N, hidden)
+    shapes = [data_s, pshape, sshape]
+    if mode == "lstm":
+        shapes.append(sshape)
+    outs = [(T, N, hidden * d)]
+    if state_outputs:
+        outs.append(sshape)
+        if mode == "lstm":
+            outs.append(sshape)
+    return shapes, outs, []
+
+
+def _rnn_num_outputs(attrs):
+    if not parse_bool(attrs.get("state_outputs", False)):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+@register("RNN", arg_names=_rnn_args, infer_shape=_rnn_infer_shape,
+          num_outputs=_rnn_num_outputs, needs_rng=True)
+def _rnn(ins, attrs, ctx):
+    data = ins[0]
+    params = ins[1]
+    state_h = ins[2]
+    state_c = ins[3] if len(ins) > 3 else None
+    out, hN, cN = _rnn_impl(data, params, state_h, state_c, attrs, ctx)
+    if not parse_bool(attrs.get("state_outputs", False)):
+        return out
+    if cN is not None:
+        return out, hN, cN
+    return out, hN

@@ -142,7 +142,18 @@ class FusedTrainStep:
         opt_attrs = dict(self._opt_attrs)
         n_states = self._n_states
 
-        def step(params, opt_states, aux, key, lr, batch):
+        adam_b1 = float(opt_attrs.get("beta1", 0.9))
+        adam_b2 = float(opt_attrs.get("beta2", 0.999))
+        is_adam = self._opt_op == "adam_update"
+
+        def step(params, opt_states, aux, key, lr, t, batch):
+            if is_adam:
+                # Adam bias correction folded into lr, matching
+                # optimizer.Adam (optimizer.py): lr·√(1-β2ᵗ)/(1-β1ᵗ)
+                import jax.numpy as _jnp
+
+                lr = lr * _jnp.sqrt(1.0 - _jnp.power(adam_b2, t)) \
+                    / (1.0 - _jnp.power(adam_b1, t))
             def f(p):
                 args = dict(batch)
                 args.update(p)
@@ -176,7 +187,7 @@ class FusedTrainStep:
 
         return jax.jit(
             step,
-            in_shardings=(param_sh, state_sh, aux_sh, None, None,
+            in_shardings=(param_sh, state_sh, aux_sh, None, None, None,
                           batch_shardings),
             out_shardings=(param_sh, state_sh, aux_sh, None),
             donate_argnums=(0, 1, 2))
@@ -204,7 +215,7 @@ class FusedTrainStep:
             vals[n] = a
         self.params, self.opt_states, self.aux, outs = self._step_fn(
             self.params, self.opt_states, self.aux, self._key,
-            jnp.float32(lr), vals)
+            jnp.float32(lr), jnp.float32(self.num_update), vals)
         return outs
 
     # ------------------------------------------------------------- params

@@ -1,0 +1,220 @@
+"""RecordIO (``python/mxnet/recordio.py``, dmlc recordio format).
+
+Binary-compatible with the reference container so ``.rec`` datasets packed
+by im2rec interoperate: records framed by magic ``0xced7230a`` + a
+length/continue-flag word, 4-byte aligned; ``IRHeader`` (flag, label, id,
+id2) prefixes packed items.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+from typing import Optional
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "IndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential record file reader/writer
+    (``src/io/ recordio`` capability)."""
+
+    def __init__(self, uri: str, flag: str):
+        self.uri = uri
+        self.flag = flag
+        self.fp = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fp = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fp = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError("invalid flag %s" % self.flag)
+
+    def close(self):
+        if self.fp is not None:
+            self.fp.close()
+            self.fp = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["fp"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self) -> int:
+        return self.fp.tell()
+
+    def write(self, buf: bytes):
+        assert self.writable
+        self.fp.write(struct.pack("<I", _MAGIC))
+        self.fp.write(struct.pack("<I", len(buf)))
+        self.fp.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.fp.write(b"\x00" * pad)
+
+    def read(self) -> Optional[bytes]:
+        assert not self.writable
+        head = self.fp.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            raise MXNetError("invalid record magic")
+        length = lrec & ((1 << 29) - 1)
+        buf = self.fp.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.fp.read(pad)
+        return buf
+
+
+class IndexedRecordIO(MXRecordIO):
+    """Random-access record file with a ``.idx`` sidecar
+    (reference ``IndexedRecordIO``)."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str,
+                 key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+        else:
+            self.fidx = open(self.idx_path, "r")
+            for line in self.fidx:
+                parts = line.strip().split("\t")
+                key = self.key_type(parts[0])
+                self.idx[key] = int(parts[1])
+                self.keys.append(key)
+
+    def close(self):
+        super().close()
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+
+    def seek(self, idx):
+        assert not self.writable
+        self.fp.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf: bytes):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Prefix data with an IRHeader (multi-label via flag>0)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, 0, float(header.label), header.id,
+                          header.id2)
+        return hdr + s
+    label = np.asarray(header.label, dtype=np.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s: bytes):
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = np.frombuffer(s[:flag * 4], dtype=np.float32)
+        s = s[flag * 4:]
+    return IRHeader(flag, label, id_, id2), s
+
+
+def pack_img(header: IRHeader, img, quality: int = 95,
+             img_fmt: str = ".jpg") -> bytes:
+    """Encode an image array and pack (PNG via pure python; JPEG requires
+    cv2/PIL when available)."""
+    buf = _encode_img(np.asarray(img), img_fmt, quality)
+    return pack(header, buf)
+
+
+def unpack_img(s: bytes, iscolor=-1):
+    header, img_bytes = unpack(s)
+    img = _decode_img(img_bytes)
+    return header, img
+
+
+def _encode_img(img: np.ndarray, fmt: str, quality: int) -> bytes:
+    try:
+        import cv2
+
+        ok, enc = cv2.imencode(fmt, img,
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+        return enc.tobytes()
+    except ImportError:
+        pass
+    try:
+        import io as _io
+
+        from PIL import Image
+
+        b = _io.BytesIO()
+        Image.fromarray(img.astype(np.uint8)).save(
+            b, format="PNG" if "png" in fmt else "JPEG", quality=quality)
+        return b.getvalue()
+    except ImportError:
+        # raw fallback: shape-prefixed uint8 (self-describing)
+        hdr = struct.pack("<III", *(img.shape + (1,) * (3 - img.ndim))[:3])
+        return b"RAW0" + hdr + img.astype(np.uint8).tobytes()
+
+
+def _decode_img(buf: bytes) -> np.ndarray:
+    if buf[:4] == b"RAW0":
+        h, w, c = struct.unpack("<III", buf[4:16])
+        return np.frombuffer(buf[16:], dtype=np.uint8).reshape(h, w, c)
+    try:
+        import cv2
+
+        return cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), -1)
+    except ImportError:
+        pass
+    import io as _io
+
+    from PIL import Image
+
+    return np.asarray(Image.open(_io.BytesIO(buf)))

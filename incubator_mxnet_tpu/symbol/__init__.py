@@ -203,6 +203,10 @@ class Symbol:
                         from ..ops.registry import parse_tuple
 
                         s = parse_tuple(sa)  # handles str round-trip via JSON
+                # reference convention: a 0 dim means "unknown" — treat the
+                # whole shape as uninferred so op rules back-fill it
+                if s is not None and any(d == 0 for d in s):
+                    s = None
                 var_shapes.setdefault(node.name, s)
                 node_out_shapes[(id(node), 0)] = var_shapes[node.name]
                 continue
@@ -312,6 +316,20 @@ class Symbol:
 
     def __neg__(self):
         return _create("negative", [self], {})
+
+    def __gt__(self, o):
+        return self._compose_binary(o, "_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._compose_binary(o, "_greater_equal",
+                                    "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._compose_binary(o, "_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._compose_binary(o, "_lesser_equal",
+                                    "_lesser_equal_scalar")
 
     # ---------------------------------------------------------------- binder
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,

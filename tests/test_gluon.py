@@ -1,0 +1,217 @@
+"""Gluon tests — reference ``tests/python/unittest/test_gluon*.py``
+semantics."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu import autograd as ag
+from incubator_mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(5, 4))
+    p.initialize(init=mx.init.Xavier(), ctx=[mx.cpu(0)])
+    assert p.data().shape == (5, 4)
+    assert p.grad().shape == (5, 4)
+    p.zero_grad()
+    np.testing.assert_allclose(p.grad().asnumpy(), np.zeros((5, 4)))
+
+
+def test_parameter_dict_get_shared():
+    params = gluon.ParameterDict("net_")
+    a = params.get("w", shape=(2, 2))
+    b = params.get("w")
+    assert a is b
+    assert a.name == "net_w"
+
+
+def test_dense_forward_and_deferred_init():
+    layer = nn.Dense(8, in_units=4)
+    layer.initialize()
+    x = mx.nd.ones((2, 4))
+    out = layer(x)
+    assert out.shape == (2, 8)
+
+    # deferred: in_units unknown until first forward
+    layer2 = nn.Dense(3)
+    layer2.initialize()
+    out2 = layer2(mx.nd.ones((5, 7)))
+    assert out2.shape == (5, 3)
+    assert layer2.weight.shape == (3, 7)
+
+
+def test_sequential_and_training():
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 10).astype(np.float32)
+    w = rng.randn(10, 1).astype(np.float32)
+    y = x.dot(w) + 0.1
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore="local")
+    l2 = gluon.loss.L2Loss()
+
+    losses = []
+    for epoch in range(30):
+        with ag.record():
+            out = net(mx.nd.array(x))
+            loss = l2(out, mx.nd.array(y))
+        loss.backward()
+        trainer.step(128)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0] * 0.2, losses[::10]
+
+
+def test_conv_block():
+    layer = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3)
+    layer.initialize()
+    out = layer(mx.nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 8, 8, 8)
+
+    pool = nn.MaxPool2D(2, 2)
+    assert pool(out).shape == (2, 8, 4, 4)
+
+    gap = nn.GlobalAvgPool2D()
+    assert gap(out).shape == (2, 8, 1, 1)
+
+
+def test_batchnorm_block_updates_running_stats():
+    layer = nn.BatchNorm(in_channels=4)
+    layer.initialize()
+    x = mx.nd.array(np.random.randn(16, 4, 3, 3).astype(np.float32) * 3 + 1)
+    with ag.record():
+        out = layer(x)
+    rm = layer.running_mean.data().asnumpy()
+    assert np.abs(rm).sum() > 0
+
+
+def test_hybridize():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.ones((3, 7))
+    out_imp = net(x).asnumpy()
+    net.hybridize()
+    out_hyb = net(x).asnumpy()
+    np.testing.assert_allclose(out_imp, out_hyb, rtol=1e-5)
+
+
+def test_losses():
+    pred = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = mx.nd.array([[1.5, 2.5], [2.0, 5.0]])
+    l2 = gluon.loss.L2Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(
+        l2, ((pred.asnumpy() - label.asnumpy()) ** 2 / 2).mean(1),
+        rtol=1e-5)
+
+    l1 = gluon.loss.L1Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(
+        l1, np.abs(pred.asnumpy() - label.asnumpy()).mean(1), rtol=1e-5)
+
+    logits = mx.nd.array(np.random.randn(4, 5).astype(np.float32))
+    lab = mx.nd.array([0, 1, 2, 3])
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()(logits, lab).asnumpy()
+    lp = np.log(np.exp(logits.asnumpy())
+                / np.exp(logits.asnumpy()).sum(1, keepdims=True))
+    expect = -lp[np.arange(4), [0, 1, 2, 3]]
+    np.testing.assert_allclose(ce, expect, rtol=1e-4)
+
+
+def test_save_load_params(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    _ = net(mx.nd.ones((1, 6)))
+    fname = str(tmp_path / "net.params")
+    net.save_params(fname)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(4), nn.Dense(2))
+    net2.load_params(fname)
+    out1 = net(mx.nd.ones((1, 6))).asnumpy()
+    out2 = net2(mx.nd.ones((1, 6))).asnumpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+
+def test_dataset_dataloader():
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.arange(10, dtype=np.float32)
+    ds = gluon.data.ArrayDataset(x, y)
+    assert len(ds) == 10
+    loader = gluon.data.DataLoader(ds, batch_size=3, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (3, 2)
+
+    loader2 = gluon.data.DataLoader(ds, batch_size=3, shuffle=True,
+                                    last_batch="discard")
+    assert len(list(loader2)) == 3
+
+
+def test_vision_dataset_synthetic():
+    ds = gluon.data.vision.MNIST(root="/nonexistent_mnist")
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    assert 0 <= label < 10
+
+
+def test_split_and_load():
+    data = mx.nd.arange(0, 16).reshape((8, 2))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(0), mx.cpu(1)])
+    assert parts[0].shape == (4, 2)
+    np.testing.assert_allclose(
+        np.concatenate([p.asnumpy() for p in parts]), data.asnumpy())
+
+
+def test_clip_global_norm():
+    arrays = [mx.nd.ones((2, 2)) * 3, mx.nd.ones((2,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(total - 1.0) < 1e-4
+
+
+def test_lstm_cell_unroll():
+    cell = gluon.rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    x = mx.nd.ones((2, 5, 4))  # NTC
+    outputs, states = cell.unroll(5, x, layout="NTC")
+    assert outputs.shape == (2, 5, 8)
+    assert states[0].shape == (2, 8)
+
+
+def test_lstm_layer_forward_backward():
+    layer = gluon.rnn.LSTM(16, num_layers=2, input_size=8)
+    layer.initialize()
+    x = mx.nd.array(np.random.randn(10, 4, 8).astype(np.float32))
+    out = layer(x)
+    assert out.shape == (10, 4, 16)
+
+    # grads flow to per-layer params through the fused op
+    params = layer.collect_params()
+    with ag.record():
+        out = layer(x)
+        loss = mx.nd.sum(out * out)
+    loss.backward()
+    g = params[layer.prefix + "l0_i2h_weight"].grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_gru_bidirectional():
+    layer = gluon.rnn.GRU(6, num_layers=1, bidirectional=True,
+                          input_size=3)
+    layer.initialize()
+    out = layer(mx.nd.ones((7, 2, 3)))
+    assert out.shape == (7, 2, 12)
+
+
+def test_model_zoo_construct():
+    for name in ["resnet18_v1", "resnet18_v2", "squeezenet1.1", "alexnet"]:
+        net = gluon.model_zoo.get_model(name, classes=10)
+        net.initialize(mx.init.Xavier())
+        out = net(mx.nd.ones((1, 3, 224, 224)))
+        assert out.shape == (1, 10), name

@@ -23,6 +23,8 @@ def _mlp(nclass=4):
 
 
 def test_module_fit_mlp_converges():
+    np.random.seed(0)
+    mx.random.seed(0)
     x, y = _make_dataset()
     train = mx.io.NDArrayIter(x, y, batch_size=40, shuffle=True)
     mod = mx.mod.Module(_mlp(), context=mx.cpu())
