@@ -1,0 +1,195 @@
+"""Profiler — per-op stats and Chrome ``chrome://tracing`` JSON dump.
+
+Reference analog: ``src/engine/profiler.{h,cc}`` (``Profiler``,
+``OprExecStat``, ``EmitEvent``) + ``python/mxnet/profiler.py``
+(``profiler_set_config`` / ``profiler_set_state``) + the atexit dump wired
+in ``src/initialize.cc:57-66``.
+
+TPU-native design: two complementary capture layers share one trace file —
+
+1. **Engine-level op events** via the ``Engine`` profile hook (the analog of
+   ``ExecuteOprBlock``'s ``OprExecStat`` capture,
+   ``src/engine/threaded_engine.h:312-325``).  These are host-side dispatch
+   spans; on TPU the device work is asynchronous, so these measure the
+   python-visible cost exactly the way the reference's engine measured
+   worker-thread spans.
+2. **XLA/device traces** via ``jax.profiler`` (``start_trace``/
+   ``stop_trace`` → TensorBoard/XPlane) for true on-device timing — the
+   TPU replacement for per-kernel CUDA timing.
+
+Env controls (reference ``docs/how_to/env_var.md:99-107``):
+``TP_PROFILER_AUTOSTART=1`` starts profiling at import and dumps at exit;
+``TP_PROFILER_MODE`` ∈ {``symbolic``, ``all``} (``MXNET_PROFILER_MODE``);
+``TP_PROFILER_FILENAME`` overrides the output path.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+from .base import get_env
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "pause", "resume", "Scope", "start_xla_trace", "stop_xla_trace"]
+
+_lock = threading.Lock()
+
+
+class _Event:
+    __slots__ = ("name", "t0", "t1", "tid", "cat")
+
+    def __init__(self, name, t0, t1, tid, cat):
+        self.name = name
+        self.t0 = t0
+        self.t1 = t1
+        self.tid = tid
+        self.cat = cat
+
+
+class _Profiler:
+    """Singleton state (``Profiler::Get()``)."""
+
+    def __init__(self):
+        self.mode = get_env("PROFILER_MODE", "symbolic") or "symbolic"
+        self.filename = get_env("PROFILER_FILENAME", "profile.json")
+        self.running = False
+        self.events: List[_Event] = []
+        self._hook_installed = False
+        self._epoch = time.perf_counter()
+
+    def now_us(self, t: float) -> float:
+        return (t - self._epoch) * 1e6
+
+    def record(self, name: str, t0: float, t1: float,
+               cat: str = "operator") -> None:
+        if not self.running:
+            return
+        ev = _Event(name, t0, t1, threading.get_ident(), cat)
+        with _lock:
+            self.events.append(ev)
+
+    def install_hook(self) -> None:
+        if self._hook_installed:
+            return
+        from .engine import engine
+
+        engine().add_profile_hook(self._on_op)
+        self._hook_installed = True
+
+    def _on_op(self, name: str, t0: float, t1: float) -> None:
+        # MXNET_PROFILER_MODE=symbolic excludes imperative engine ops
+        # (env_var.md:99-107); the engine hook only sees imperative ops
+        # here (symbolic work is inside jitted programs)
+        if self.mode == "symbolic":
+            return
+        self.record(name, t0, t1)
+
+    def dump(self, fname: Optional[str] = None) -> str:
+        """Write accumulated events as Chrome trace-event JSON
+        (``Profiler::DumpProfile`` / ``EmitEvent``, profiler.h:75-148)."""
+        fname = fname or self.filename
+        with _lock:
+            events = list(self.events)
+        traces = []
+        # process-name metadata, like EmitPid
+        tids = sorted({e.tid for e in events})
+        for i, tid in enumerate(tids):
+            traces.append({"ph": "M", "args": {"name": "engine thread %d"
+                                               % i},
+                           "pid": 0, "tid": tid,
+                           "name": "thread_name"})
+        for e in events:
+            traces.append({
+                "name": e.name, "cat": e.cat, "ph": "B",
+                "ts": self.now_us(e.t0), "pid": 0, "tid": e.tid,
+            })
+            traces.append({
+                "name": e.name, "cat": e.cat, "ph": "E",
+                "ts": self.now_us(e.t1), "pid": 0, "tid": e.tid,
+            })
+        with open(fname, "w") as f:
+            json.dump({"traceEvents": traces, "displayTimeUnit": "ms"}, f)
+        return fname
+
+
+_prof = _Profiler()
+
+
+def profiler_set_config(mode: str = "symbolic",
+                        filename: str = "profile.json") -> None:
+    """``MXSetProfilerConfig`` analog."""
+    _prof.mode = mode
+    _prof.filename = filename
+
+
+def profiler_set_state(state: str = "stop") -> None:
+    """``MXSetProfilerState``: 'run' starts capture, 'stop' dumps."""
+    if state in ("run", 1):
+        with _lock:
+            _prof.events = []  # fresh capture per run/stop session
+        _prof.install_hook()
+        _prof.running = True
+    elif state in ("stop", 0):
+        _prof.running = False
+        _prof.dump()
+    else:
+        raise ValueError("state must be 'run' or 'stop'")
+
+
+def pause() -> None:
+    _prof.running = False
+
+
+def resume() -> None:
+    _prof.install_hook()
+    _prof.running = True
+
+
+def dump_profile(fname: Optional[str] = None) -> str:
+    return _prof.dump(fname)
+
+
+class Scope:
+    """Context manager recording a named span (python-side custom events —
+    the analog of profiling a cached-op segment)."""
+
+    def __init__(self, name: str, cat: str = "python"):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        _prof.record(self.name, self._t0, time.perf_counter(), self.cat)
+        return False
+
+
+# -- on-device XLA traces ----------------------------------------------------
+
+
+def start_xla_trace(logdir: str = "/tmp/tp_xla_trace") -> None:
+    """Start a jax/XLA device trace (TensorBoard XPlane format) — the TPU
+    replacement for per-kernel CUDA timing."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def stop_xla_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+# -- autostart (initialize.cc:57-66 atexit dump) -----------------------------
+
+if (os.environ.get("TP_PROFILER_AUTOSTART") or
+        os.environ.get("MXNET_PROFILER_AUTOSTART")) == "1":
+    resume()
+    atexit.register(lambda: _prof.dump())

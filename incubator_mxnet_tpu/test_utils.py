@@ -1,0 +1,396 @@
+"""Testing utilities — the numeric contract of the reference test suite.
+
+Reference analog: ``python/mxnet/test_utils.py`` — ``numeric_grad`` (:379),
+``check_numeric_gradient`` (:439), ``check_symbolic_forward`` (:552),
+``check_symbolic_backward`` (:617), ``check_consistency`` (:784),
+``rand_ndarray``, ``assert_almost_equal``.  SURVEY.md §4: "the contract is
+*numeric*, not structural" — ops vs numpy oracles, finite-difference
+gradients, cross-context equivalence.
+
+TPU adaptation of ``check_consistency``: the reference cross-compared
+cpu/gpu/fp16 contexts.  Here the axes of variation are jax device kinds
+(cpu host backend vs the TPU chip) and dtypes (float32 vs bfloat16/float16),
+which exercises exactly what differs between compiled variants on TPU.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array as nd_array
+
+__all__ = [
+    "default_context", "assert_almost_equal", "almost_equal", "same",
+    "rand_shape_2d", "rand_shape_3d", "rand_shape_nd", "rand_ndarray",
+    "random_arrays", "numeric_grad", "check_numeric_gradient",
+    "check_symbolic_forward", "check_symbolic_backward",
+    "check_consistency", "simple_forward",
+]
+
+_DEFAULT_RTOL = 1e-5
+_DEFAULT_ATOL = 1e-20
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+
+
+def _to_numpy(a) -> np.ndarray:
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    if isinstance(a, np.ndarray):
+        return a
+    return np.asarray(a)
+
+
+def same(a, b) -> bool:
+    return np.array_equal(_to_numpy(a), _to_numpy(b))
+
+
+def _find_max_violation(a, b, rtol, atol):
+    error = np.abs(a - b) - atol - rtol * np.abs(b)
+    if error.size == 0:
+        return None, 0.0
+    idx = tuple(int(i) for i in
+                np.unravel_index(np.argmax(error), error.shape))
+    return idx, error[idx]
+
+
+def almost_equal(a, b, rtol=None, atol=None) -> bool:
+    a, b = _to_numpy(a), _to_numpy(b)
+    rtol = _DEFAULT_RTOL if rtol is None else rtol
+    atol = _DEFAULT_ATOL if atol is None else atol
+    return np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    """Assert allclose with an error report pinpointing the worst element
+    (reference ``assert_almost_equal`` / ``find_max_violation``)."""
+    a, b = _to_numpy(a), _to_numpy(b)
+    rtol = _DEFAULT_RTOL if rtol is None else rtol
+    atol = _DEFAULT_ATOL if atol is None else atol
+    if a.shape != b.shape:
+        raise AssertionError("shape mismatch: %s %s vs %s %s"
+                             % (names[0], a.shape, names[1], b.shape))
+    if np.allclose(a.astype(np.float64), b.astype(np.float64),
+                   rtol=rtol, atol=atol, equal_nan=True):
+        return
+    af, bf = a.astype(np.float64), b.astype(np.float64)
+    idx, err = _find_max_violation(af, bf, rtol, atol)
+    raise AssertionError(
+        "Arrays not almost equal (rtol=%g atol=%g): max violation %g at "
+        "index %s: %s=%r vs %s=%r" % (rtol, atol, err, idx,
+                                      names[0], af[idx], names[1], bf[idx]))
+
+
+# ---------------------------------------------------------------------------
+# random data
+# ---------------------------------------------------------------------------
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, ctx=None, dtype=np.float32, scale=1.0):
+    return nd_array((np.random.uniform(-scale, scale, size=shape)
+                     .astype(dtype)), ctx=ctx)
+
+
+def random_arrays(*shapes, dtype=np.float32) -> List[np.ndarray]:
+    arrays = [np.array(np.random.randn(), dtype=dtype) if len(s) == 0
+              else np.random.randn(*s).astype(dtype) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+# ---------------------------------------------------------------------------
+# location/expected normalization
+# ---------------------------------------------------------------------------
+
+
+def _parse_location(sym, location, ctx) -> Dict[str, np.ndarray]:
+    arg_names = sym.list_arguments()
+    if isinstance(location, dict):
+        bad = set(location) - set(arg_names)
+        if bad:
+            raise MXNetError("location keys %s not in arguments %s"
+                             % (sorted(bad), arg_names))
+        loc = {k: _to_numpy(v) for k, v in location.items()}
+    else:
+        loc = {k: _to_numpy(v) for k, v in zip(arg_names, location)}
+    return loc
+
+
+def _parse_aux(sym, aux_states) -> Dict[str, np.ndarray]:
+    aux_names = sym.list_auxiliary_states()
+    if aux_states is None:
+        return {}
+    if isinstance(aux_states, dict):
+        return {k: _to_numpy(v) for k, v in aux_states.items()}
+    return {k: _to_numpy(v) for k, v in zip(aux_names, aux_states)}
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Forward a symbol with numpy inputs, return numpy outputs."""
+    shapes = {k: v.shape for k, v in inputs.items()}
+    exe = sym.simple_bind(ctx=ctx, grad_req="null", **shapes)
+    exe.copy_params_from(inputs, allow_extra_params=True)
+    outs = [o.asnumpy() for o in exe.forward(is_train=is_train)]
+    return outs[0] if len(outs) == 1 else outs
+
+
+# ---------------------------------------------------------------------------
+# numeric gradient
+# ---------------------------------------------------------------------------
+
+
+def numeric_grad(executor, location: Dict[str, np.ndarray],
+                 aux_states=None, eps=1e-4,
+                 use_forward_train=True) -> Dict[str, np.ndarray]:
+    """Central finite differences of sum(outputs) w.r.t. each location
+    entry (reference ``numeric_grad``, test_utils.py:379)."""
+
+    def f_sum(name, vals):
+        executor.copy_params_from({name: vals.astype(np.float32)},
+                                  allow_extra_params=True)
+        outs = executor.forward(is_train=use_forward_train) or \
+            executor.outputs
+        return sum(float(o.asnumpy().astype(np.float64).sum())
+                   for o in outs)
+
+    grads = {}
+    for name, base in location.items():
+        base = base.astype(np.float64).copy()
+        grad = np.zeros_like(base)
+        flat, gflat = base.reshape(-1), grad.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps / 2
+            f_pos = f_sum(name, base)
+            flat[i] = orig - eps / 2
+            f_neg = f_sum(name, base)
+            gflat[i] = (f_pos - f_neg) / eps
+            flat[i] = orig
+        f_sum(name, base)  # restore original values
+        grads[name] = grad
+    return grads
+
+
+def check_numeric_gradient(sym, location, aux_states=None,
+                           numeric_eps=1e-3, rtol=1e-2, atol=None,
+                           grad_nodes=None, ctx=None):
+    """Compare the symbol's compiled VJP gradients against central finite
+    differences (reference ``check_numeric_gradient``, test_utils.py:439).
+
+    The scalar objective is ``sum(out * random_proj)`` so every output
+    element contributes with a distinct weight.
+    """
+    ctx = ctx or current_context()
+    loc = _parse_location(sym, location, ctx)
+    aux = _parse_aux(sym, aux_states)
+    if grad_nodes is None:
+        grad_nodes = list(loc.keys())
+
+    # project each output with fixed random weights -> scalar loss
+    from . import symbol as S
+
+    proj_syms = []
+    proj_vals = {}
+    arg_shapes, out_shapes, _ = sym.infer_shape(
+        **{k: v.shape for k, v in loc.items()})
+    for i, oshape in enumerate(out_shapes):
+        pname = "__random_proj_%d" % i
+        proj_vals[pname] = np.random.normal(
+            0, 0.1, size=oshape).astype(np.float32)
+        proj_syms.append(
+            S.sum(sym[i] * S.Variable(pname, shape=oshape)))
+    out = proj_syms[0]
+    for s in proj_syms[1:]:
+        out = out + s
+
+    grad_req = {n: ("write" if n in grad_nodes else "null")
+                for n in out.list_arguments()}
+    shapes = {k: v.shape for k, v in loc.items()}
+    shapes.update({k: v.shape for k, v in proj_vals.items()})
+    exe = out.simple_bind(ctx=ctx, grad_req=grad_req, **shapes)
+    exe.copy_params_from(loc, allow_extra_params=True)
+    exe.copy_params_from(proj_vals, allow_extra_params=True)
+    if aux:
+        exe.copy_params_from({}, aux)
+
+    exe.forward(is_train=True)
+    exe.backward()
+    sym_grads = {n: exe.grad_dict[n].asnumpy() for n in grad_nodes
+                 if n in exe.grad_dict}
+
+    # numeric: finite differences of the same projected scalar (the bound
+    # executor's single output IS the scalar, so numeric_grad's
+    # sum-of-outputs objective matches the VJP's cotangent exactly)
+    num_grads = numeric_grad(exe, {n: loc[n] for n in grad_nodes},
+                             eps=numeric_eps)
+    atol_eff = rtol if atol is None else atol
+    for name in grad_nodes:
+        assert_almost_equal(sym_grads[name], num_grads[name],
+                            rtol=rtol, atol=atol_eff,
+                            names=("symbolic_grad[%s]" % name,
+                                   "numeric_grad[%s]" % name))
+
+
+# ---------------------------------------------------------------------------
+# symbolic forward/backward vs expected
+# ---------------------------------------------------------------------------
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None, is_train=False):
+    """Bind, forward, compare each output to ``expected`` numpy arrays
+    (reference test_utils.py:552)."""
+    ctx = ctx or current_context()
+    loc = _parse_location(sym, location, ctx)
+    aux = _parse_aux(sym, aux_states)
+    shapes = {k: v.shape for k, v in loc.items()}
+    exe = sym.simple_bind(ctx=ctx, grad_req="null", **shapes)
+    exe.copy_params_from(loc, aux or None, allow_extra_params=True)
+    outs = exe.forward(is_train=is_train) or exe.outputs
+    if isinstance(expected, dict):
+        expected = [expected[k] for k in sym.list_outputs()]
+    for got, want, nm in zip(outs, expected, sym.list_outputs()):
+        assert_almost_equal(got, want, rtol=rtol,
+                            atol=(rtol if atol is None else atol),
+                            names=("forward[%s]" % nm, "expected"))
+    return [o.asnumpy() for o in outs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected,
+                            rtol=1e-5, atol=None, aux_states=None,
+                            grad_req="write", ctx=None):
+    """Bind with grads, forward+backward with given head grads, compare
+    input grads to expected (reference test_utils.py:617)."""
+    ctx = ctx or current_context()
+    loc = _parse_location(sym, location, ctx)
+    aux = _parse_aux(sym, aux_states)
+    shapes = {k: v.shape for k, v in loc.items()}
+    if isinstance(grad_req, str):
+        req = {k: grad_req for k in sym.list_arguments()}
+    else:
+        req = dict(grad_req) if isinstance(grad_req, dict) else \
+            dict(zip(sym.list_arguments(), grad_req))
+    exe = sym.simple_bind(ctx=ctx, grad_req=req, **shapes)
+    exe.copy_params_from(loc, aux or None, allow_extra_params=True)
+    # seed 'add' grads with a known value to verify accumulation
+    add_seed = {}
+    for name, r in req.items():
+        if r == "add" and name in exe.grad_dict:
+            g = exe.grad_dict[name]
+            seed = np.random.normal(size=g.shape).astype(np.float32)
+            add_seed[name] = seed
+            g._set_data(nd_array(seed, ctx=ctx).data)
+    exe.forward(is_train=True)
+    ogs = None
+    if out_grads is not None:
+        if isinstance(out_grads, dict):
+            out_grads = [out_grads[k] for k in sym.list_outputs()]
+        ogs = [nd_array(_to_numpy(g), ctx=ctx).data for g in out_grads]
+    exe.backward(ogs)
+    if isinstance(expected, dict):
+        items = expected.items()
+    else:
+        items = zip(sym.list_arguments(), expected)
+    grads = {}
+    for name, want in items:
+        if want is None:
+            continue
+        got = exe.grad_dict[name].asnumpy()
+        want = _to_numpy(want)
+        if name in add_seed:
+            want = want + add_seed[name]
+        assert_almost_equal(got, want, rtol=rtol,
+                            atol=(rtol if atol is None else atol),
+                            names=("grad[%s]" % name, "expected"))
+        grads[name] = got
+    return grads
+
+
+# ---------------------------------------------------------------------------
+# cross-variant consistency
+# ---------------------------------------------------------------------------
+
+
+def check_consistency(sym, ctx_list=None, dtypes=(np.float32, np.float16),
+                      shapes=None, rtol=None, atol=None, scale=1.0,
+                      grad_req="write", aux_states=None):
+    """Run the same symbol under several variants and cross-compare outputs
+    and gradients (reference ``check_consistency``, test_utils.py:784 —
+    cpu vs gpu vs fp16 contexts).
+
+    TPU adaptation: variants are dtypes (f32 vs bf16/f16) on the current
+    device — the compiled-program axes that actually differ here.  The
+    lowest-precision variant sets the tolerance, as in the reference.
+    """
+    if shapes is None:
+        raise MXNetError("check_consistency requires input shapes")
+
+    arg_names = sym.list_arguments()
+    # randomize EVERY argument (weights included) with one shared draw so
+    # the cross-variant comparison exercises the full compute path — the
+    # reference seeds arg_params identically across contexts
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    base = {n: np.random.normal(0, scale, size=s).astype(np.float64)
+            for n, s in zip(arg_names, arg_shapes)}
+
+    results = []
+    for dt in dtypes:
+        loc = {n: v.astype(np.float32) for n, v in base.items()}
+        exe = sym.simple_bind(ctx=current_context(), grad_req=grad_req,
+                              type_dict={n: dt for n in arg_names},
+                              **{k: tuple(v) for k, v in shapes.items()})
+        exe.copy_params_from(loc, allow_extra_params=True)
+        exe.forward(is_train=True)
+        exe.backward()
+        results.append({
+            "dtype": dt,
+            "outputs": [o.asnumpy().astype(np.float64)
+                        for o in exe.outputs],
+            "grads": {n: g.asnumpy().astype(np.float64)
+                      for n, g in exe.grad_dict.items()},
+        })
+
+    def _tol_for(dt):
+        return 1e-1 if np.dtype(dt).itemsize <= 2 else 1e-3
+
+    ref = results[0]
+    for other in results[1:]:
+        # lowest precision of the PAIR sets the tolerance
+        t = rtol if rtol is not None else max(_tol_for(ref["dtype"]),
+                                              _tol_for(other["dtype"]))
+        a = atol if atol is not None else t
+        for i, (x, y) in enumerate(zip(ref["outputs"], other["outputs"])):
+            assert_almost_equal(x, y, rtol=t, atol=a,
+                                names=("out%d[%s]" % (i, ref["dtype"]),
+                                       "out%d[%s]" % (i, other["dtype"])))
+        for n in ref["grads"]:
+            assert_almost_equal(ref["grads"][n], other["grads"][n],
+                                rtol=t, atol=a,
+                                names=("grad[%s][%s]" % (n, ref["dtype"]),
+                                       "grad[%s][%s]"
+                                       % (n, other["dtype"])))
+    return results

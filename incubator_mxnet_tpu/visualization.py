@@ -1,0 +1,150 @@
+"""Network visualization — ``plot_network`` / ``print_summary``.
+
+Reference analog: ``python/mxnet/visualization.py`` (graphviz plot of the
+symbol JSON graph + layer-table summary with parameter counts).  Works over
+the same Symbol DAG the executor lowers; graphviz is optional (dot source is
+always produced, rendering needs the library).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _node_label(node) -> str:
+    if node.is_variable:
+        return node.name
+    op = node.op.name
+    a = node.attrs
+    if op == "Convolution":
+        return "Convolution\n%s/%s, %s" % (a.get("kernel"), a.get("stride",
+                                                                  "(1,1)"),
+                                           a.get("num_filter"))
+    if op == "FullyConnected":
+        return "FullyConnected\n%s" % a.get("num_hidden")
+    if op == "Pooling":
+        return "Pooling\n%s, %s/%s" % (a.get("pool_type", "max"),
+                                       a.get("kernel"),
+                                       a.get("stride", "(1,1)"))
+    if op in ("Activation", "LeakyReLU"):
+        return "%s\n%s" % (op, a.get("act_type", ""))
+    return op
+
+
+def print_summary(symbol, shape: Optional[Dict] = None,
+                  line_length: int = 120,
+                  positions=(.44, .64, .74, 1.)) -> None:
+    """Layer table: name, output shape, #params, previous layers
+    (reference ``print_summary``)."""
+    shape_dict = {}
+    if shape is not None:
+        internals = symbol.get_internals()
+        _, out_shapes, _ = internals.infer_shape(**shape)
+        shape_dict = dict(zip(internals.list_outputs(), out_shapes))
+
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(cols, pos):
+        line = ""
+        for col, p in zip(cols, pos):
+            line += str(col)
+            line = line[:p].ljust(p)
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields, positions)
+    print("=" * line_length)
+    total_params = 0
+
+    for node in symbol.topo_nodes():
+        if node.is_variable:
+            continue
+        out_name = node.output_names()[0]
+        out_shape = shape_dict.get(out_name, "")
+        # parameter count: product of shapes of variable inputs
+        n_params = 0
+        prev = []
+        for inp, _ in node.inputs:
+            if inp.is_variable:
+                s = shape_dict.get(inp.name)
+                if s:
+                    p = 1
+                    for d in s:
+                        p *= d
+                    n_params += p
+            else:
+                prev.append(inp.name)
+        total_params += n_params
+        print_row(["%s (%s)" % (node.name, node.op.name),
+                   out_shape, n_params, ",".join(prev)], positions)
+        print("_" * line_length)
+    print("Total params: %d" % total_params)
+    print("_" * line_length)
+
+
+def plot_network(symbol, title: str = "plot",
+                 shape: Optional[Dict] = None, node_attrs=None,
+                 save_format: str = "pdf", hide_weights: bool = True):
+    """Graphviz digraph of the symbol (reference ``plot_network``).
+
+    Returns a ``graphviz.Digraph`` if the library is importable, else a
+    string of dot source (same graph either way).
+    """
+    shape_dict = {}
+    if shape is not None:
+        internals = symbol.get_internals()
+        _, out_shapes, _ = internals.infer_shape(**shape)
+        shape_dict = dict(zip(internals.list_outputs(), out_shapes))
+
+    fill = {"Convolution": "#fb8072", "FullyConnected": "#fb8072",
+            "BatchNorm": "#bebada", "Activation": "#ffffb3",
+            "LeakyReLU": "#ffffb3", "Pooling": "#80b1d3",
+            "Concat": "#fdb462", "Flatten": "#fdb462",
+            "Reshape": "#fdb462", "SoftmaxOutput": "#b3de69"}
+
+    nodes = symbol.topo_nodes()
+    hidden = set()
+    if hide_weights:
+        for node in nodes:
+            if node.op is not None:
+                for pos, (inp, _) in enumerate(node.inputs):
+                    if inp.is_variable and pos >= 1:
+                        hidden.add(id(inp))
+
+    lines = ["digraph %s {" % json_safe(title)]
+    for node in nodes:
+        if id(node) in hidden:
+            continue
+        label = _node_label(node).replace("\n", "\\n")
+        out_shape = shape_dict.get(node.output_names()[0])
+        if out_shape:
+            label += "\\n%s" % (tuple(out_shape),)
+        color = "#8dd3c7" if node.is_variable else \
+            fill.get(node.op.name, "#fccde5")
+        lines.append('  "%s" [label="%s", style=filled, fillcolor="%s", '
+                     'shape=box];' % (node.name, label, color))
+    for node in nodes:
+        for inp, _ in node.inputs:
+            if id(inp) in hidden:
+                continue
+            lines.append('  "%s" -> "%s";' % (inp.name, node.name))
+    lines.append("}")
+    src = "\n".join(lines)
+
+    try:
+        from graphviz import Digraph  # type: ignore
+
+        dot = Digraph(name=title, format=save_format)
+        # feed pre-built source body
+        dot.body = [ln for ln in lines[1:-1]]
+        return dot
+    except ImportError:
+        return src
+
+
+def json_safe(s: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in s)

@@ -1,0 +1,41 @@
+"""Profiler: chrome-trace dump of imperative op events.
+
+Reference analog: ``tests/python/unittest/test_profiler.py`` — configure,
+run ops, dump, check the JSON is a valid chrome trace.
+"""
+import json
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import profiler
+
+
+def test_profiler_chrome_trace(tmp_path):
+    out = str(tmp_path / "profile.json")
+    profiler.profiler_set_config(mode="all", filename=out)
+    profiler.profiler_set_state("run")
+    a = mx.nd.ones((16, 16))
+    b = mx.nd.ones((16, 16))
+    for _ in range(3):
+        c = (a * b + a).asnumpy()
+    profiler.profiler_set_state("stop")
+
+    with open(out) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    names = {e["name"] for e in events if e.get("ph") == "B"}
+    assert any("mul" in n or "add" in n for n in names), names
+    # every B has a matching E
+    assert sum(e["ph"] == "B" for e in events) == \
+        sum(e["ph"] == "E" for e in events)
+
+
+def test_profiler_scope(tmp_path):
+    out = str(tmp_path / "scope.json")
+    profiler.profiler_set_config(filename=out)
+    profiler.resume()
+    with profiler.Scope("my_step"):
+        mx.nd.ones((4,)).asnumpy()
+    profiler.pause()
+    path = profiler.dump_profile(out)
+    names = {e["name"] for e in json.load(open(path))["traceEvents"]}
+    assert "my_step" in names

@@ -1,0 +1,94 @@
+"""Tests for mx.test_utils — the numeric-check harness itself.
+
+Mirrors how the reference suite uses ``test_utils`` in
+``tests/python/unittest/test_operator.py``: finite-difference grads and
+numpy-oracle forward/backward checks on small symbols.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import test_utils as tu
+
+
+def test_assert_almost_equal_reports_index():
+    a = np.zeros((2, 3))
+    b = np.zeros((2, 3))
+    b[1, 2] = 1.0
+    with pytest.raises(AssertionError) as e:
+        tu.assert_almost_equal(a, b, rtol=1e-5, atol=1e-8)
+    assert "(1, 2)" in str(e.value)
+    tu.assert_almost_equal(a, a)
+
+
+def test_rand_ndarray_and_same():
+    arr = tu.rand_ndarray((3, 4))
+    assert arr.shape == (3, 4)
+    assert tu.same(arr, arr.asnumpy())
+
+
+def test_check_symbolic_forward_mul():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = a * b + a
+    x = np.random.rand(3, 4).astype(np.float32)
+    y = np.random.rand(3, 4).astype(np.float32)
+    tu.check_symbolic_forward(out, {"a": x, "b": y}, [x * y + x],
+                              rtol=1e-5)
+
+
+def test_check_symbolic_backward_mul():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = a * b
+    x = np.random.rand(3, 4).astype(np.float32)
+    y = np.random.rand(3, 4).astype(np.float32)
+    og = np.random.rand(3, 4).astype(np.float32)
+    tu.check_symbolic_backward(out, {"a": x, "b": y}, [og],
+                               {"a": og * y, "b": og * x}, rtol=1e-5)
+
+
+def test_check_symbolic_backward_add_req():
+    a = mx.sym.Variable("a")
+    out = a * 3.0
+    x = np.random.rand(2, 2).astype(np.float32)
+    og = np.ones((2, 2), np.float32)
+    # grad_req='add' must accumulate onto the seeded grad buffer
+    tu.check_symbolic_backward(out, {"a": x}, [og], {"a": og * 3.0},
+                               grad_req="add", rtol=1e-5)
+
+
+def test_check_numeric_gradient_dense():
+    np.random.seed(7)
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    out = mx.sym.FullyConnected(data, weight=w, no_bias=True, num_hidden=3,
+                                name="fc")
+    tu.check_numeric_gradient(
+        out, {"data": np.random.rand(2, 4).astype(np.float32),
+              "w": np.random.rand(3, 4).astype(np.float32)},
+        numeric_eps=1e-3, rtol=5e-2)
+
+
+def test_check_numeric_gradient_nonlinear():
+    np.random.seed(11)
+    x = mx.sym.Variable("x")
+    out = mx.sym.tanh(x)
+    tu.check_numeric_gradient(
+        out, {"x": np.random.uniform(-1, 1, (3, 3)).astype(np.float32)},
+        numeric_eps=1e-3, rtol=5e-2)
+
+
+def test_check_consistency_dtype():
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    tu.check_consistency(out, dtypes=(np.float32, np.float16),
+                         shapes={"data": (2, 8)})
+
+
+def test_simple_forward():
+    x = mx.sym.Variable("x")
+    out = mx.sym.relu(x)
+    val = np.array([[-1.0, 2.0]], np.float32)
+    got = tu.simple_forward(out, x=val)
+    np.testing.assert_allclose(got, np.maximum(val, 0))

@@ -1,0 +1,30 @@
+"""print_summary / plot_network over a small conv net (reference
+``python/mxnet/visualization.py`` behavior)."""
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import visualization as viz
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="conv1")
+    a = mx.sym.Activation(c, act_type="relu", name="relu1")
+    p = mx.sym.Pooling(a, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="pool1")
+    f = mx.sym.Flatten(p, name="flat")
+    fc = mx.sym.FullyConnected(f, num_hidden=10, name="fc1")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def test_print_summary(capsys):
+    viz.print_summary(_net(), shape={"data": (1, 3, 16, 16)})
+    out = capsys.readouterr().out
+    assert "conv1" in out and "fc1" in out
+    assert "Total params" in out
+
+
+def test_plot_network_dot():
+    g = viz.plot_network(_net(), shape={"data": (1, 3, 16, 16)})
+    src = g if isinstance(g, str) else "\n".join(g.body)
+    assert "conv1" in src and '"conv1" -> "relu1"' in src
+    # weight/bias variables hidden by default
+    assert "conv1_weight" not in src
