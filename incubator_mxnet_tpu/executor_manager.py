@@ -1,0 +1,129 @@
+"""Legacy multi-device executor helpers (``python/mxnet/executor_manager.py``).
+
+``DataParallelExecutorManager`` predates Module in the reference; kept for
+API parity.  Internally it drives the same
+:class:`~incubator_mxnet_tpu.module.executor_group.DataParallelExecutorGroup`
+the Module stack uses.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base import MXNetError
+from .module.executor_group import (DataParallelExecutorGroup,
+                                    _split_input_slice)
+from .ndarray.ndarray import NDArray
+
+__all__ = ["_split_input_slice", "_check_arguments",
+           "DataParallelExecutorManager"]
+
+
+def _check_arguments(symbol):
+    """Reject duplicate argument/aux names
+    (reference ``executor_manager.py:68``)."""
+    arg_names = symbol.list_arguments()
+    if len(set(arg_names)) != len(arg_names):
+        seen = set()
+        for name in arg_names:
+            if name in seen:
+                raise MXNetError(
+                    "Find duplicated argument name \"%s\"; please make the "
+                    "weight name non-duplicated, arguments are %s"
+                    % (name, str(arg_names)))
+            seen.add(name)
+    aux_names = symbol.list_auxiliary_states()
+    if len(set(aux_names)) != len(aux_names):
+        raise MXNetError("Duplicated auxiliary state names")
+
+
+class DataParallelExecutorManager:
+    """Helper managing per-device executors for data parallelism
+    (reference ``executor_manager.py:295``)."""
+
+    def __init__(self, symbol, ctx, train_data, arg_names=None,
+                 param_names=None, aux_names=None, work_load_list=None,
+                 logger=None, sym_gen=None):
+        if logger is None:
+            logger = logging
+        self.symbol = symbol
+        self.sym_gen = sym_gen
+        self.ctx = ctx
+        self.logger = logger
+        num_device = len(ctx)
+        logger.info("Start training with %s", str(ctx))
+
+        if work_load_list is None:
+            work_load_list = [1] * num_device
+        assert isinstance(work_load_list, list) and \
+            len(work_load_list) == num_device
+        self.work_load_list = work_load_list
+
+        self.arg_names = arg_names or symbol.list_arguments()
+        self.aux_names = aux_names or symbol.list_auxiliary_states()
+        input_names = [d[0] for d in train_data.provide_data] + \
+            [l[0] for l in (train_data.provide_label or [])]
+        self.param_names = param_names or \
+            [n for n in self.arg_names if n not in input_names]
+
+        self.slices = _split_input_slice(train_data.batch_size,
+                                         work_load_list)
+        self.execgrp = DataParallelExecutorGroup(
+            symbol, ctx, work_load_list, train_data.provide_data,
+            train_data.provide_label, self.param_names, for_training=True,
+            logger=logger)
+        self.execgrp_bucket = {}
+        if sym_gen is not None and \
+                getattr(train_data, "default_bucket_key", None) is not None:
+            self.execgrp_bucket[train_data.default_bucket_key] = \
+                self.execgrp
+        self.curr_execgrp = self.execgrp
+
+    def install_monitor(self, monitor):
+        if self.sym_gen is not None:
+            raise MXNetError(
+                "Monitoring is not implemented for bucketing")
+        self.execgrp.install_monitor(monitor)
+
+    def set_params(self, arg_params, aux_params):
+        self.execgrp.set_params(arg_params, aux_params)
+
+    def copy_to(self, arg_params, aux_params):
+        """Copy device params back into the given host dicts."""
+        self.curr_execgrp.get_params(arg_params, aux_params)
+
+    @property
+    def param_arrays(self):
+        return self.curr_execgrp.param_arrays
+
+    @property
+    def grad_arrays(self):
+        return self.curr_execgrp.grad_arrays
+
+    @property
+    def aux_arrays(self):
+        return self.curr_execgrp.aux_arrays
+
+    def load_data_batch(self, data_batch):
+        if self.sym_gen is not None and \
+                data_batch.bucket_key not in (None,):
+            key = data_batch.bucket_key
+            if key not in self.execgrp_bucket:
+                symbol = self.sym_gen(key)
+                self.execgrp_bucket[key] = DataParallelExecutorGroup(
+                    symbol, self.ctx, self.work_load_list,
+                    data_batch.provide_data, data_batch.provide_label,
+                    self.param_names, for_training=True,
+                    shared_group=self.execgrp, logger=self.logger)
+            self.curr_execgrp = self.execgrp_bucket[key]
+        self._pending_batch = data_batch
+
+    def forward(self, is_train=False):
+        self.curr_execgrp.forward(self._pending_batch, is_train=is_train)
+
+    def backward(self):
+        self.curr_execgrp.backward()
+
+    def update_metric(self, metric, labels):
+        self.curr_execgrp.update_metric(metric, labels)

@@ -13,8 +13,8 @@ from .base import MXNetError
 from .ndarray import load as nd_load, save as nd_save
 from .ndarray.ndarray import NDArray
 
-__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
-           "_create_kvstore", "_initialize_kvstore",
+__all__ = ["BatchEndParam", "FeedForward", "save_checkpoint",
+           "load_checkpoint", "_create_kvstore", "_initialize_kvstore",
            "_update_params_on_kvstore", "_update_params"]
 
 BatchEndParam = namedtuple("BatchEndParams",
@@ -120,3 +120,233 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
             updater(index * num_device + k, g, w)
+
+
+class FeedForward:
+    """Legacy estimator API (reference ``model.py:408`` ``FeedForward``):
+    scikit-style ``fit(X, y)`` / ``predict(X)`` over a symbol.  Internally
+    drives the Module stack (the reference drove
+    ``DataParallelExecutorManager``; Module supersedes it there too).
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from .context import cpu
+        from .initializer import Uniform
+
+        self.symbol = symbol
+        self.ctx = ctx if ctx is not None else [cpu()]
+        if not isinstance(self.ctx, list):
+            self.ctx = [self.ctx]
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.kwargs = kwargs.copy()
+        self.optimizer = optimizer
+        self.initializer = initializer if initializer is not None \
+            else Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.argument_checked = False
+        self._pred_exec = None
+
+    def _check_arguments(self):
+        if self.argument_checked:
+            return
+        from .executor_manager import _check_arguments
+        assert self.symbol is not None
+        self.argument_checked = True
+        _check_arguments(self.symbol)
+        if self.allow_extra_params and self.arg_params:
+            arg_names = set(self.symbol.list_arguments())
+            self.arg_params = {k: v for k, v in self.arg_params.items()
+                               if k in arg_names}
+        if self.allow_extra_params and self.aux_params:
+            aux_names = set(self.symbol.list_auxiliary_states())
+            self.aux_params = {k: v for k, v in self.aux_params.items()
+                               if k in aux_names}
+
+    @staticmethod
+    def _is_data_arg(name):
+        return name.endswith("data") or name.endswith("label")
+
+    def _init_iter(self, X, y, is_train):
+        """Coerce numpy/NDArray input into a DataIter
+        (reference ``model.py:583``)."""
+        import numpy as np
+
+        from .io import DataIter, NDArrayIter
+        from .ndarray.ndarray import NDArray
+
+        if isinstance(X, DataIter) or (hasattr(X, "provide_data") and
+                                       hasattr(X, "reset")):
+            return X
+        if isinstance(X, NDArray):
+            X = X.asnumpy()
+        if isinstance(y, NDArray):
+            y = y.asnumpy()
+        if not isinstance(X, np.ndarray):
+            raise TypeError("X must be DataIter, NDArray or numpy.ndarray")
+        if y is None:
+            if is_train:
+                raise ValueError("y must be specified when X is numpy")
+            y = np.zeros(X.shape[0])
+        y = np.asarray(y)
+        if y.ndim == 2 and y.shape[1] == 1:
+            y = y.flatten()
+        batch_size = min(X.shape[0], self.numpy_batch_size)
+        return NDArrayIter(X, y, batch_size=batch_size, shuffle=is_train,
+                           last_batch_handle="roll_over" if is_train
+                           else "pad")
+
+    def _init_eval_iter(self, eval_data):
+        if eval_data is None:
+            return eval_data
+        if isinstance(eval_data, (tuple, list)) and len(eval_data) == 2:
+            return self._init_iter(eval_data[0], eval_data[1],
+                                   is_train=True)
+        return eval_data
+
+    def _make_module(self, data_iter, logger=None, work_load_list=None):
+        import logging as _logging
+
+        from .module import Module
+
+        data_names = [d[0] for d in data_iter.provide_data]
+        label_names = [l[0] for l in (data_iter.provide_label or [])]
+        return Module(self.symbol, data_names=data_names,
+                      label_names=label_names, context=self.ctx,
+                      logger=logger or _logging,
+                      work_load_list=work_load_list)
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None,
+            monitor=None, eval_end_callback=None,
+            eval_batch_end_callback=None):
+        """Train (reference ``model.py:748``)."""
+        data = self._init_iter(X, y, is_train=True)
+        eval_data = self._init_eval_iter(eval_data)
+        self._check_arguments()
+
+        mod = self._make_module(data, logger=logger,
+                                work_load_list=work_load_list)
+        mod.fit(data, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self.optimizer,
+                optimizer_params=dict(self.kwargs),
+                initializer=self.initializer,
+                arg_params=self.arg_params, aux_params=self.aux_params,
+                allow_missing=(self.arg_params is None),
+                begin_epoch=self.begin_epoch,
+                num_epoch=self.num_epoch, monitor=monitor,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback)
+        self.arg_params, self.aux_params = mod.get_params()
+        self._pred_exec = None
+        return self
+
+    def _init_predictor(self, data_iter):
+        """Bind (and cache) the inference module — avoids recompiling the
+        XLA program on every predict/score call (reference
+        ``model.py:567`` cached ``_pred_exec``)."""
+        key = tuple(tuple(d) for d in data_iter.provide_data)
+        if self._pred_exec is not None and self._pred_exec[0] == key:
+            return self._pred_exec[1]
+        mod = self._make_module(data_iter)
+        mod.bind(data_shapes=data_iter.provide_data,
+                 label_shapes=data_iter.provide_label, for_training=False)
+        mod.set_params(self.arg_params or {}, self.aux_params or {},
+                       allow_missing=(self.arg_params is None))
+        self._pred_exec = (key, mod)
+        return mod
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """Batched inference; returns numpy (reference ``model.py:628``)."""
+        import numpy as np
+
+        data = self._init_iter(X, None, is_train=False)
+        self._check_arguments()
+        if reset:
+            data.reset()
+        mod = self._init_predictor(data)
+
+        outputs = []
+        data_list, label_list = [], []
+        for i, batch in enumerate(data):
+            if num_batch is not None and i == num_batch:
+                break
+            mod.forward(batch, is_train=False)
+            pad = batch.pad
+            outs = [o[0:o.shape[0] - pad].asnumpy()
+                    for o in mod.get_outputs()]
+            outputs.append(outs)
+            if return_data:
+                data_list.append(batch.data[0][0:batch.data[0].shape[0]
+                                               - pad].asnumpy())
+                if batch.label:
+                    label_list.append(
+                        batch.label[0][0:batch.label[0].shape[0]
+                                       - pad].asnumpy())
+        if not outputs:
+            return [] if not return_data else ([], None, None)
+        n_out = len(outputs[0])
+        merged = [np.concatenate([o[i] for o in outputs], axis=0)
+                  for i in range(n_out)]
+        result = merged[0] if n_out == 1 else merged
+        if return_data:
+            return (result, np.concatenate(data_list, axis=0),
+                    np.concatenate(label_list, axis=0)
+                    if label_list else None)
+        return result
+
+    def score(self, X, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        """Evaluate (reference ``model.py:697``)."""
+        data = self._init_iter(X, None, is_train=False)
+        self._check_arguments()
+        mod = self._init_predictor(data)
+        res = mod.score(data, eval_metric, num_batch=num_batch,
+                        batch_end_callback=batch_end_callback, reset=reset)
+        return res[0][1] if res else None
+
+    def save(self, prefix, epoch=None):
+        """Checkpoint as ``prefix-symbol.json`` + ``prefix-NNNN.params``
+        (reference ``model.py:850``)."""
+        if epoch is None:
+            epoch = self.num_epoch
+        assert epoch is not None
+        save_checkpoint(prefix, epoch, self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """Reload a checkpointed estimator (reference ``model.py:873``)."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               epoch_size=None, optimizer="sgd", initializer=None,
+               eval_data=None, eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Construct + fit in one call (reference ``model.py:904``)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

@@ -311,6 +311,17 @@ class Module(BaseModule):
         assert self.binded
         self._exec_group.install_monitor(mon)
 
+    def borrow_optimizer(self, shared_module: "Module") -> None:
+        """Share optimizer/kvstore/updater state with another Module bound
+        over the same params (reference ``module.py`` borrow_optimizer;
+        used by BucketingModule so every bucket steps the same state)."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
     # -------------------------------------------------------- opt states
     def save_optimizer_states(self, fname: str):
         assert self.optimizer_initialized

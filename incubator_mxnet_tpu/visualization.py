@@ -40,7 +40,10 @@ def print_summary(symbol, shape: Optional[Dict] = None,
     """Layer table: name, output shape, #params, previous layers
     (reference ``print_summary``)."""
     shape_dict = {}
+    input_names = set()
     if shape is not None:
+        # names the caller feeds (data/label) are inputs, not parameters
+        input_names = set(shape.keys())
         internals = symbol.get_internals()
         _, out_shapes, _ = internals.infer_shape(**shape)
         shape_dict = dict(zip(internals.list_outputs(), out_shapes))
@@ -69,7 +72,8 @@ def print_summary(symbol, shape: Optional[Dict] = None,
         n_params = 0
         prev = []
         for inp, _ in node.inputs:
-            if inp.is_variable:
+            if inp.is_variable and inp.name not in input_names \
+                    and not inp.name.endswith("label"):
                 s = shape_dict.get(inp.name)
                 if s:
                     p = 1

@@ -1,0 +1,231 @@
+"""BucketingModule / SequentialModule / PythonModule / FeedForward /
+executor_manager tests — reference ``tests/python/unittest/test_module.py``
+(test_module_states, test_bucket_module) and ``test_bucketing.py``."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.io import DataBatch
+
+
+def _make_dataset(n=200, nclass=4, dim=16, seed=3):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(nclass, dim).astype(np.float32) * 3
+    y = rng.randint(0, nclass, n)
+    x = centers[y] + rng.randn(n, dim).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def _mlp_for_dim(dim, nclass=4):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=nclass)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _bucket_invariant_net(nclass=2):
+    """Params must not depend on the bucket key (like RNN cells in the
+    reference's bucketing examples): pool over the variable axis first."""
+    data = mx.sym.Variable("data")
+    pooled = mx.sym.mean(data, axis=1, keepdims=True)
+    net = mx.sym.FullyConnected(pooled, name="fc1", num_hidden=8)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=nclass)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+class _BucketIter:
+    """Yields batches whose trailing dim varies by bucket (seq-len
+    analog of the reference BucketSentenceIter usage)."""
+
+    def __init__(self, buckets, batch_size=8, nclass=4, batches=6):
+        self.buckets = buckets
+        self.batch_size = batch_size
+        self.nclass = nclass
+        self.batches = batches
+        self.default_bucket_key = max(buckets)
+        self.provide_data = [("data", (batch_size,
+                                       self.default_bucket_key))]
+        self.provide_label = [("softmax_label", (batch_size,))]
+        self.reset()
+
+    def reset(self):
+        self._i = 0
+        self._rng = np.random.RandomState(7)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._i >= self.batches:
+            raise StopIteration
+        self._i += 1
+        key = self.buckets[self._i % len(self.buckets)]
+        x = self._rng.randn(self.batch_size, key).astype(np.float32)
+        y = (x.sum(axis=1) > 0).astype(np.float32)
+        return DataBatch(
+            data=[mx.nd.array(x)], label=[mx.nd.array(y)], pad=0,
+            bucket_key=key,
+            provide_data=[("data", (self.batch_size, key))],
+            provide_label=[("softmax_label", (self.batch_size,))])
+
+
+def test_bucketing_module_trains_across_buckets():
+    buckets = [8, 12, 16]
+    it = _BucketIter(buckets)
+    mod = mx.mod.BucketingModule(
+        sym_gen=lambda key: (_bucket_invariant_net(nclass=2),
+                             ("data",), ("softmax_label",)),
+        default_bucket_key=it.default_bucket_key, context=mx.cpu())
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier())
+    assert set(mod._buckets.keys()) == set(buckets)
+    # all buckets must share the fc2 weight (same device array object)
+    default = mod._buckets[it.default_bucket_key]
+    other = mod._buckets[8]
+    w_d = default._exec_group.execs[0].arg_dict["fc2_weight"]
+    w_o = other._exec_group.execs[0].arg_dict["fc2_weight"]
+    assert w_d is w_o, "buckets do not share parameters"
+
+
+def test_bucketing_module_get_set_params_roundtrip():
+    it = _BucketIter([8, 16])
+    mod = mx.mod.BucketingModule(
+        sym_gen=lambda key: (_bucket_invariant_net(nclass=2),
+                             ("data",), ("softmax_label",)),
+        default_bucket_key=16, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    args, auxs = mod.get_params()
+    assert "fc1_weight" in args
+    args2 = {k: v * 0 for k, v in args.items()}
+    mod.set_params(args2, auxs)
+    new_args, _ = mod.get_params()
+    assert float(new_args["fc1_weight"].asnumpy().sum()) == 0.0
+
+
+def test_sequential_module_fit():
+    x, y = _make_dataset(n=160)
+    train = mx.io.NDArrayIter(x, y, batch_size=40)
+
+    net1 = mx.sym.Variable("data")
+    net1 = mx.sym.FullyConnected(net1, name="fc1", num_hidden=16)
+    net1 = mx.sym.Activation(net1, name="relu1", act_type="relu")
+
+    net2 = mx.sym.Variable("data")
+    net2 = mx.sym.FullyConnected(net2, name="fc2", num_hidden=4)
+    net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+
+    mod1 = mx.mod.Module(net1, label_names=[], context=mx.cpu())
+    mod2 = mx.mod.Module(net2, context=mx.cpu())
+    seq = mx.mod.SequentialModule()
+    seq.add(mod1).add(mod2, take_labels=True, auto_wiring=True)
+
+    seq.fit(train, num_epoch=6, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.initializer.Xavier())
+    score = seq.score(train, "acc")
+    assert score[0][1] > 0.8, "sequential module failed to learn: %s" \
+        % score
+
+
+def test_python_loss_module_chain():
+    # linear regression via PythonLossModule's default L2 gradient
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(5, 1).astype(np.float32)
+    x = rng.randn(120, 5).astype(np.float32)
+    y = (x @ w_true).astype(np.float32)
+    train = mx.io.NDArrayIter(x, y, batch_size=30,
+                              label_name="softmax_label")
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, name="fc", num_hidden=1, no_bias=True)
+    mod = mx.mod.Module(net, label_names=[], context=mx.cpu())
+    loss = mx.mod.PythonLossModule(
+        grad_func=lambda scores, labels:
+        scores - labels.reshape(scores.shape))
+    seq = mx.mod.SequentialModule()
+    seq.add(mod).add(loss, take_labels=True, auto_wiring=True)
+    seq.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    seq.init_params(mx.initializer.Uniform(0.1))
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for _ in range(30):
+        train.reset()
+        for batch in train:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+    w_learned = seq.get_params()[0]["fc_weight"].asnumpy()
+    np.testing.assert_allclose(w_learned.ravel(), w_true.ravel(),
+                               atol=0.05)
+
+
+def test_feedforward_fit_predict_save_load(tmp_path):
+    x, y = _make_dataset(n=160)
+    net = _mlp_for_dim(16)
+    model = mx.model.FeedForward(net, ctx=mx.cpu(), num_epoch=5,
+                                 optimizer="sgd", learning_rate=0.5,
+                                 momentum=0.9, numpy_batch_size=40,
+                                 initializer=mx.initializer.Xavier())
+    model.fit(x, y)
+    preds = model.predict(x)
+    assert preds.shape == (160, 4)
+    acc = float((preds.argmax(1) == y).mean())
+    assert acc > 0.9, acc
+    assert model.score(mx.io.NDArrayIter(x, y, batch_size=40),
+                       "acc") > 0.9
+
+    prefix = str(tmp_path / "ff")
+    model.save(prefix)
+    reloaded = mx.model.FeedForward.load(prefix, 5, ctx=mx.cpu())
+    preds2 = reloaded.predict(x)
+    np.testing.assert_allclose(preds.asnumpy() if hasattr(preds, "asnumpy")
+                               else preds, preds2, rtol=1e-5)
+
+
+def test_executor_manager_forward_backward():
+    from incubator_mxnet_tpu.executor_manager import (
+        DataParallelExecutorManager, _check_arguments)
+
+    x, y = _make_dataset(n=80)
+    train = mx.io.NDArrayIter(x, y, batch_size=40)
+    sym = _mlp_for_dim(16)
+    _check_arguments(sym)
+    mgr = DataParallelExecutorManager(sym, [mx.cpu(0), mx.cpu(1)], train)
+    arg_params = {n: mx.nd.zeros(b[0].shape)
+                  for n, b in zip(mgr.param_names, mgr.param_arrays)}
+    init = mx.initializer.Xavier()
+    for name, arr in arg_params.items():
+        init(mx.initializer.InitDesc(name), arr)
+    mgr.set_params(arg_params, {})
+    batch = next(iter(train))
+    mgr.load_data_batch(batch)
+    mgr.forward(is_train=True)
+    mgr.backward()
+    metric = mx.metric.create("acc")
+    mgr.update_metric(metric, batch.label)
+    assert metric.get()[1] >= 0.0
+
+
+def test_bucketing_prepare_keeps_current_module():
+    # regression: prepare(next_batch) must not redirect get_outputs()
+    it = _BucketIter([8, 16])
+    mod = mx.mod.BucketingModule(
+        sym_gen=lambda key: (_bucket_invariant_net(nclass=2),
+                             ("data",), ("softmax_label",)),
+        default_bucket_key=16, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    batches = list(it)
+    b16 = next(b for b in batches if b.bucket_key == 16)
+    b8 = next(b for b in batches if b.bucket_key == 8)
+    mod.forward(b16, is_train=False)
+    out_before = mod.get_outputs()[0].asnumpy()
+    mod.prepare(b8)  # pre-binds bucket 8; must not switch current module
+    out_after = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_array_equal(out_before, out_after)
+    assert mod._curr_bucket_key == 16
