@@ -9,12 +9,9 @@ from __future__ import annotations
 
 import logging
 
-import numpy as np
-
 from .base import MXNetError
 from .module.executor_group import (DataParallelExecutorGroup,
                                     _split_input_slice)
-from .ndarray.ndarray import NDArray
 
 __all__ = ["_split_input_slice", "_check_arguments",
            "DataParallelExecutorManager"]

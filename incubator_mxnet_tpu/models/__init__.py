@@ -11,13 +11,14 @@ from .alexnet import get_symbol as alexnet
 from .resnet import get_symbol as resnet
 from .vgg import get_symbol as vgg
 from .inception_bn import get_symbol as inception_bn
+from .lstm_ptb import get_symbol as lstm_ptb, lstm_ptb_sym_gen
 
 __all__ = ["lenet", "mlp", "alexnet", "resnet", "vgg", "inception_bn",
-           "get_symbol"]
+           "lstm_ptb", "lstm_ptb_sym_gen", "get_symbol"]
 
 _ZOO = {"lenet": lenet, "mlp": mlp, "alexnet": alexnet, "resnet": resnet,
         "vgg": vgg, "inception-bn": inception_bn,
-        "inception_bn": inception_bn}
+        "inception_bn": inception_bn, "lstm_ptb": lstm_ptb}
 
 
 def get_symbol(network: str, **kwargs):

@@ -1,0 +1,53 @@
+"""PTB word-level language model — BASELINE config 3.
+
+Reference analog: ``example/rnn/lstm_bucketing.py`` (stacked LSTM over
+embeddings, per-bucket unroll, SoftmaxOutput over the flattened time
+dim).  TPU-native: the same sym_gen works with either unrolled cells
+(static graph per bucket) or ``FusedRNNCell`` (one ``lax.scan`` per
+layer, preferred on TPU — no per-bucket recompile of the recurrence).
+"""
+from __future__ import annotations
+
+from .. import rnn as rnn_mod
+from .. import symbol as sym
+
+__all__ = ["lstm_ptb_sym_gen", "get_symbol"]
+
+
+def lstm_ptb_sym_gen(num_embed=200, num_hidden=200, num_layers=2,
+                     vocab_size=10000, dropout=0.0, fused=True):
+    """Returns ``sym_gen(seq_len) -> (symbol, data_names, label_names)``
+    for BucketingModule."""
+
+    if fused:
+        stack = rnn_mod.FusedRNNCell(num_hidden, num_layers=num_layers,
+                                     mode="lstm", dropout=dropout,
+                                     prefix="lstm_")
+    else:
+        stack = rnn_mod.SequentialRNNCell()
+        for i in range(num_layers):
+            stack.add(rnn_mod.LSTMCell(num_hidden,
+                                       prefix="lstm_l%d_" % i))
+            if dropout > 0 and i < num_layers - 1:
+                stack.add(rnn_mod.DropoutCell(dropout))
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        embed = sym.Embedding(data, input_dim=vocab_size,
+                              output_dim=num_embed, name="embed")
+        stack.reset()
+        outputs, states = stack.unroll(seq_len, inputs=embed,
+                                       merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, num_hidden))
+        pred = sym.FullyConnected(pred, num_hidden=vocab_size,
+                                  name="pred")
+        label_flat = sym.Reshape(label, shape=(-1,))
+        out = sym.SoftmaxOutput(pred, label_flat, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    return sym_gen
+
+
+def get_symbol(seq_len=35, **kwargs):
+    return lstm_ptb_sym_gen(**kwargs)(seq_len)[0]

@@ -151,6 +151,7 @@ class BucketingModule(BaseModule):
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self.binded = True
+        self._grad_req = grad_req
 
         sym, data_names, label_names = self._gen_symbol(
             self._default_bucket_key)
@@ -185,7 +186,8 @@ class BucketingModule(BaseModule):
                         for_training, self._curr_module.inputs_need_grad,
                         force_rebind=False,
                         shared_module=self._buckets[
-                            self._default_bucket_key])
+                            self._default_bucket_key],
+                        grad_req=getattr(self, "_grad_req", "write"))
             if self.optimizer_initialized:
                 module.borrow_optimizer(
                     self._buckets[self._default_bucket_key])

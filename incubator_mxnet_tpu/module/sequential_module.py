@@ -150,8 +150,8 @@ class SequentialModule(BaseModule):
             if meta.get(self.META_AUTO_WIRING, False):
                 data_names = module.data_names
                 assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [(new_name, shape)
-                                  for new_name, (_, shape) in
+                my_data_shapes = [(new_name, d[1])
+                                  for new_name, d in
                                   zip(data_names, my_data_shapes)]
 
             module.bind(data_shapes=my_data_shapes,

@@ -1,0 +1,9 @@
+"""``mx.rnn`` — symbolic RNN cells, bucketed data io, checkpoints
+(reference ``python/mxnet/rnn/``)."""
+from .io import BucketSentenceIter, encode_sentences
+from .rnn import (do_rnn_checkpoint, load_rnn_checkpoint,
+                  save_rnn_checkpoint)
+from .rnn_cell import (BaseRNNCell, BidirectionalCell, DropoutCell,
+                       FusedRNNCell, GRUCell, LSTMCell, ModifierCell,
+                       ResidualCell, RNNCell, RNNParams,
+                       SequentialRNNCell, ZoneoutCell)

@@ -1,0 +1,47 @@
+"""RNN checkpoint helpers (reference ``python/mxnet/rnn/rnn.py``):
+fused↔unfused weight conversion around the standard two-file checkpoint.
+"""
+from __future__ import annotations
+
+from ..model import load_checkpoint, save_checkpoint
+
+__all__ = ["save_rnn_checkpoint", "load_rnn_checkpoint",
+           "do_rnn_checkpoint"]
+
+
+def _as_cell_list(cells):
+    if not isinstance(cells, (list, tuple)):
+        return [cells]
+    return list(cells)
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params,
+                        aux_params):
+    """Unpacks cell weights (fused vector → per-gate) before saving so
+    checkpoints are portable across fused/unfused models
+    (reference ``rnn/rnn.py:32``)."""
+    cells = _as_cell_list(cells)
+    for cell in cells:
+        arg_params = cell.unpack_weights(arg_params)
+    save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Inverse of :func:`save_rnn_checkpoint`
+    (reference ``rnn/rnn.py:62``)."""
+    sym, arg, aux = load_checkpoint(prefix, epoch)
+    cells = _as_cell_list(cells)
+    for cell in cells:
+        arg = cell.pack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback variant (reference ``rnn/rnn.py:97``)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+
+    return _callback

@@ -165,6 +165,8 @@ def test_python_loss_module_chain():
 
 
 def test_feedforward_fit_predict_save_load(tmp_path):
+    np.random.seed(0)
+    mx.random.seed(0)
     x, y = _make_dataset(n=160)
     net = _mlp_for_dim(16)
     model = mx.model.FeedForward(net, ctx=mx.cpu(), num_epoch=5,
