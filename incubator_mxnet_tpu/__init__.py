@@ -37,7 +37,7 @@ _OPTIONAL = [
     ("monitor", ()), ("module", ("mod",)), ("name", ()), ("attribute", ()),
     ("registry", ()), ("profiler", ()), ("visualization", ("viz",)),
     ("test_utils", ()), ("parallel", ()), ("models", ()), ("gluon", ()),
-    ("rnn", ()), ("image", ()),
+    ("rnn", ()), ("image", ()), ("operator", ()), ("rtc", ()),
 ]
 
 import importlib as _importlib

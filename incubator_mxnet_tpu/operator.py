@@ -1,0 +1,323 @@
+"""Custom python operators — ``mx.operator.CustomOp`` / ``CustomOpProp``.
+
+Reference analog: ``python/mxnet/operator.py:413-676`` + the C++ side
+``src/operator/custom/custom-inl.h`` (which ran python callbacks on a
+dedicated worker thread with a task queue).
+
+TPU-native redesign: the host callback rides ``jax.pure_callback`` — XLA
+calls back into python from inside the compiled program, which is the XLA
+equivalent of the reference's callback worker thread.  Gradients are a
+``jax.custom_vjp`` whose backward is a second host callback into
+``CustomOp.backward``; that keeps custom ops usable under ``autograd``,
+``Module`` and even inside a jitted/sharded step.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+
+class CustomOp(object):
+    """Base class for custom python operators
+    (reference ``operator.py:413``)."""
+
+    def __init__(self):
+        pass
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Compute outputs from ``in_data`` (numpy arrays); write results
+        with ``self.assign(out_data[i], req[i], value)``."""
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Compute input gradients; write with
+        ``self.assign(in_grad[i], req[i], value)``."""
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the OpReqType
+        (reference ``operator.py:450``)."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] += src
+        else:
+            raise MXNetError("invalid req %s" % req)
+
+
+class CustomOpProp(object):
+    """Operator properties: names/shapes/types
+    (reference ``operator.py:459``)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        """Default: all inputs and outputs take the first input's shape."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), \
+            [in_shape[0]] * len(self.list_auxiliary_states())
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+_custom_registry: Dict[str, type] = {}
+
+
+def register(reg_name):
+    """Decorator registering a ``CustomOpProp`` subclass under
+    ``op_type=reg_name`` (reference ``operator.py:593``); usable as
+    ``mx.nd.Custom(..., op_type=reg_name)`` / ``mx.sym.Custom(...)``."""
+
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("can only register subclass of CustomOpProp")
+        _custom_registry[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered() -> List[str]:
+    return sorted(_custom_registry)
+
+
+def _make_prop(attrs: Dict[str, Any]) -> CustomOpProp:
+    op_type = attrs.get("op_type")
+    if op_type is None:
+        raise MXNetError("Custom op requires an op_type attribute")
+    if op_type not in _custom_registry:
+        raise MXNetError("custom op type '%s' is not registered; known: %s"
+                         % (op_type, get_all_registered()))
+    kwargs = {k: v for k, v in attrs.items() if k != "op_type"}
+    return _custom_registry[op_type](**kwargs)
+
+
+def _custom_arg_names(attrs):
+    return list(_make_prop(attrs).list_arguments())
+
+
+def _custom_num_outputs(attrs):
+    return len(_make_prop(attrs).list_outputs())
+
+
+def _custom_infer_shape(in_shapes, attrs):
+    prop = _make_prop(attrs)
+    n_args = len(prop.list_arguments())
+    if any(s is None for s in in_shapes[:n_args]):
+        return in_shapes, [None] * len(prop.list_outputs()), []
+    ins, outs, auxs = prop.infer_shape([list(s)
+                                        for s in in_shapes[:n_args]])
+    return [tuple(s) for s in ins], [tuple(s) for s in outs], \
+        [tuple(s) for s in auxs]
+
+
+def _install_custom_op():
+    """Register the single ``Custom`` operator that dispatches on
+    ``op_type`` (the reference did the same through the C custom-op
+    registry, ``src/c_api/c_api.cc`` MXCustomOpRegister)."""
+    import jax
+
+    from .ops.registry import register as op_register
+
+    @op_register("Custom", arg_names=_custom_arg_names,
+                 num_outputs=_custom_num_outputs,
+                 infer_shape=_custom_infer_shape)
+    def _custom(ins, attrs, ctx):
+        prop = _make_prop(attrs)
+        if prop.list_auxiliary_states():
+            raise MXNetError(
+                "Custom ops with auxiliary states are not supported on "
+                "the TPU backend yet (op_type=%s); keep mutable state on "
+                "the CustomOp instance instead" % attrs.get("op_type"))
+        in_shapes = [tuple(x.shape) for x in ins]
+        in_dtypes = [x.dtype for x in ins]
+        _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+        _, out_types, _ = prop.infer_type(list(in_dtypes))
+        out_struct = [jax.ShapeDtypeStruct(tuple(s), t)
+                      for s, t in zip(out_shapes, out_types)]
+        in_struct = [jax.ShapeDtypeStruct(s, t)
+                     for s, t in zip(in_shapes, in_dtypes)]
+        n_out = len(out_struct)
+        is_train = bool(ctx.is_train)
+        # one operator instance per bound graph, shared by forward and
+        # backward so state stashed on self in forward is visible in
+        # backward (the reference kept one Operator per executor too)
+        op_holder = []
+
+        def _operator():
+            if not op_holder:
+                op_holder.append(
+                    prop.create_operator(None, in_shapes, in_dtypes))
+            return op_holder[0]
+
+        def host_forward(*arrays):
+            op = _operator()
+            in_data = [np.asarray(a) for a in arrays]
+            out_data = [np.zeros(s.shape, s.dtype) for s in out_struct]
+            op.forward(is_train=is_train, req=["write"] * n_out,
+                       in_data=in_data, out_data=out_data, aux=[])
+            return tuple(out_data)
+
+        def host_backward(*arrays):
+            k = len(ins)
+            in_data = [np.asarray(a) for a in arrays[:k]]
+            out_data = [np.asarray(a) for a in arrays[k:k + n_out]]
+            out_grad = [np.asarray(a) for a in arrays[k + n_out:]]
+            op = _operator()
+            in_grad = [np.zeros(s, d) for s, d in zip(in_shapes,
+                                                      in_dtypes)]
+            op.backward(req=["write"] * k, out_grad=out_grad,
+                        in_data=in_data, out_data=out_data,
+                        in_grad=in_grad, aux=[])
+            return tuple(in_grad)
+
+        @jax.custom_vjp
+        def call(*xs):
+            outs = jax.pure_callback(host_forward, tuple(out_struct), *xs)
+            return tuple(outs)
+
+        def call_fwd(*xs):
+            outs = jax.pure_callback(host_forward, tuple(out_struct), *xs)
+            return tuple(outs), (xs, tuple(outs))
+
+        def call_bwd(res, gs):
+            xs, outs = res
+            grads = jax.pure_callback(host_backward, tuple(in_struct),
+                                      *(xs + outs + tuple(gs)))
+            return tuple(grads)
+
+        call.defvjp(call_fwd, call_bwd)
+        outs = call(*ins)
+        if n_out == 1:
+            return outs[0]
+        return tuple(outs)
+
+
+_install_custom_op()
+
+# refresh the generated namespaces — this module registers "Custom" after
+# mx.nd / mx.sym built their op tables at import time
+from .ndarray import _install_ops as _refresh_nd  # noqa: E402
+
+_refresh_nd()
+try:
+    from .symbol import _install as _refresh_sym  # noqa: E402
+
+    _refresh_sym()
+except ImportError:  # symbol layer not present yet during early bootstrap
+    pass
+
+
+class PythonOp(object):
+    """Deprecated v0.8-style base (reference ``operator.py:36``); prefer
+    CustomOp.  Kept for API parity — ``get_symbol`` wires the op into a
+    graph via an auto-registered CustomOpProp adapter."""
+
+    _op_counter = [0]
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = need_top_grad
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def forward(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class NumpyOp(PythonOp):
+    """Numpy-backed legacy op (reference ``operator.py:143``)."""
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym_mod
+
+        legacy = self
+
+        class _Adapter(CustomOpProp):
+            def __init__(self):
+                super().__init__(need_top_grad=legacy.need_top_grad())
+
+            def list_arguments(self):
+                return legacy.list_arguments()
+
+            def list_outputs(self):
+                return legacy.list_outputs()
+
+            def infer_shape(self, in_shape):
+                ins, outs = legacy.infer_shape(in_shape)
+                return ins, outs, []
+
+            def create_operator(self, ctx, in_shapes, in_dtypes):
+                class _Op(CustomOp):
+                    def forward(self, is_train, req, in_data, out_data,
+                                aux):
+                        legacy.forward(in_data=in_data, out_data=out_data)
+
+                    def backward(self, req, out_grad, in_data, out_data,
+                                 in_grad, aux):
+                        legacy.backward(out_grad=out_grad,
+                                        in_data=in_data,
+                                        out_data=out_data,
+                                        in_grad=in_grad)
+
+                return _Op()
+
+        PythonOp._op_counter[0] += 1
+        name = "_numpy_op_%d" % PythonOp._op_counter[0]
+        register(name)(_Adapter)
+        kwargs["op_type"] = name
+        return sym_mod.Custom(*args, **kwargs)
+
+
+NDArrayOp = NumpyOp  # the reference NDArrayOp differs only in buffer type

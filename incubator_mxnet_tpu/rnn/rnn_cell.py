@@ -598,16 +598,17 @@ class SequentialRNNCell(BaseRNNCell):
                merge_outputs=None):
         self.reset()
         num_cells = len(self._cells)
-        if begin_state is None:
-            inputs_n, _ = _normalize_sequence(length, inputs, layout,
-                                              False)
-            begin_state = self.begin_state(sample=inputs_n[0])
         p = 0
         next_states = []
         for i, cell in enumerate(self._cells):
-            n = len(cell.state_info)
-            states = begin_state[p:p + n]
-            p += n
+            if begin_state is None:
+                # each child synthesizes zeros matching its own state
+                # rank ((N,C) stepped cells vs (L,N,H) fused cells)
+                states = None
+            else:
+                n = len(cell.state_info)
+                states = begin_state[p:p + n]
+                p += n
             inputs, states = cell.unroll(
                 length, inputs=inputs, begin_state=states, layout=layout,
                 merge_outputs=None if i < num_cells - 1

@@ -1,0 +1,133 @@
+"""Runtime-compiled kernels — the TPU analog of ``mx.rtc``.
+
+Reference analog: ``python/mxnet/rtc.py`` + ``src/common/mxrtc.cc:26-159``
+(NVRTC: compile CUDA C from a python string at runtime, launch with
+explicit grid/block).  On TPU the runtime-codegen path is **Pallas**: the
+kernel body is python source compiled by Mosaic when first traced, so the
+same "write a kernel as a string / function, call it on NDArrays" UX maps
+onto ``pl.pallas_call``.
+
+Differences from CUDA RTC, by design:
+- the kernel indexes ``Ref`` blocks (``x[...]``) instead of raw threads;
+- grid/block become the pallas ``grid`` + per-input ``BlockSpec``;
+- on non-TPU backends the kernel runs in interpret mode (the reference's
+  RTC was likewise CUDA-only, guarded by ``MXNET_USE_NVRTC``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Rtc", "PallasKernel"]
+
+
+def _default_interpret() -> bool:
+    import jax
+
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+class PallasKernel:
+    """Compile a Pallas kernel from python source at runtime.
+
+    ``source`` must define a function named ``name`` taking one ``Ref``
+    per input followed by one per output::
+
+        k = PallasKernel("axpy", ["x", "y"], ["out"], '''
+        def axpy(x, y, out):
+            out[...] = 2.0 * x[...] + y[...]
+        ''')
+        out = k(x_nd, y_nd)
+
+    The body may use ``pl``/``pltpu``/``jnp``/``jax`` — they are injected
+    into the source's namespace (the reference injected CUDA builtins the
+    same way by textual wrapping, ``mxrtc.cc:101-135``).
+    """
+
+    def __init__(self, name: str, inputs: Sequence[str],
+                 outputs: Sequence[str], source: str,
+                 grid: Optional[Tuple[int, ...]] = None,
+                 interpret: Optional[bool] = None):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        try:
+            from jax.experimental.pallas import tpu as pltpu
+        except ImportError:  # pragma: no cover
+            pltpu = None
+
+        self.name = name
+        self.input_names = list(inputs)
+        self.output_names = list(outputs)
+        self.source = source
+        self.grid = grid
+        self.interpret = _default_interpret() if interpret is None \
+            else interpret
+
+        namespace = {"pl": pl, "pltpu": pltpu, "jnp": jnp, "jax": jax,
+                     "np": np}
+        exec(compile(source, "<rtc:%s>" % name, "exec"), namespace)
+        if name not in namespace or not callable(namespace[name]):
+            raise MXNetError(
+                "rtc source must define a function named '%s'" % name)
+        self._kernel = namespace[name]
+        self._pl = pl
+
+    def _call_arrays(self, ins, out_shape_dtypes):
+        import jax
+
+        pl = self._pl
+        call = pl.pallas_call(
+            self._kernel,
+            out_shape=[jax.ShapeDtypeStruct(s, d)
+                       for s, d in out_shape_dtypes],
+            grid=self.grid if self.grid is not None else (),
+            interpret=self.interpret)
+        outs = call(*ins)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        return list(outs)
+
+    def push(self, ins: Sequence[NDArray], outs: Sequence[NDArray],
+             grid_dims=None, block_dims=None) -> None:
+        """Reference-shaped launch API (``mx.rtc.push``): writes results
+        into ``outs``.  grid/block dims are accepted for signature parity;
+        pallas derives its own tiling."""
+        results = self._call_arrays(
+            [i.data for i in ins],
+            [(tuple(o.shape), o.dtype) for o in outs])
+        for o, r in zip(outs, results):
+            o[:] = np.asarray(r)
+
+    def __call__(self, *ins, out_shapes=None, out_dtypes=None):
+        """Functional form: returns new NDArrays (out shapes default to
+        the first input's)."""
+        arrays = [i.data if isinstance(i, NDArray) else i for i in ins]
+        if out_shapes is None:
+            out_shapes = [tuple(arrays[0].shape)] * len(self.output_names)
+        if out_dtypes is None:
+            out_dtypes = [arrays[0].dtype] * len(self.output_names)
+        results = self._call_arrays(arrays,
+                                    list(zip(out_shapes, out_dtypes)))
+        from .ndarray import array as nd_array
+
+        outs = [nd_array(np.asarray(r)) for r in results]
+        if len(outs) == 1:
+            return outs[0]
+        return outs
+
+
+class Rtc(PallasKernel):
+    """Name-compatible alias of the reference ``mx.rtc.Rtc``; same
+    constructor ordering (name, inputs, outputs, kernel_source)."""
+
+    def __init__(self, name, inputs, outputs, kernel):
+        super().__init__(name, inputs, outputs, kernel)

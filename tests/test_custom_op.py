@@ -1,0 +1,166 @@
+"""CustomOp / NumpyOp / rtc tests — reference
+``tests/python/unittest/test_operator.py`` (test_custom_op) and
+``tests/python/gpu/test_rtc.py``."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+import incubator_mxnet_tpu.operator as mxop
+
+
+@mxop.register("sqr")
+class SqrProp(mxop.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class Sqr(mxop.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0],
+                            2 * in_data[0] * out_grad[0])
+
+        return Sqr()
+
+
+def test_custom_op_ndarray_forward():
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = mx.nd.Custom(x, op_type="sqr")
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() ** 2)
+
+
+def test_custom_op_autograd_backward():
+    x = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="sqr")
+        loss = mx.nd.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_custom_op_in_symbol_module():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(data, op_type="sqr", name="sqr")
+    net = mx.sym.FullyConnected(net, name="fc", num_hidden=2)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    x = np.random.RandomState(0).randn(20, 4).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=10)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier())
+    out = mod.predict(it)
+    assert out.shape == (20, 2)
+
+
+def test_numpy_op_legacy():
+    class Swish(mxop.NumpyOp):
+        def forward(self, in_data, out_data):
+            x = in_data[0]
+            out_data[0][:] = x / (1 + np.exp(-x))
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            x = in_data[0]
+            s = 1 / (1 + np.exp(-x))
+            in_grad[0][:] = out_grad[0] * (s + x * s * (1 - s))
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+    op = Swish()
+    net = op(mx.sym.Variable("data"), name="swish")
+    ex = net.simple_bind(ctx=mx.cpu(), grad_req="write", data=(3, 4))
+    x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    ex.arg_dict["data"][:] = x
+    ex.forward(is_train=True)
+    expect = x / (1 + np.exp(-x))
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), expect, rtol=1e-5)
+    ex.backward(out_grads=[mx.nd.ones((3, 4))])
+    s = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               s + x * s * (1 - s), rtol=1e-4)
+
+
+def test_rtc_pallas_kernel():
+    k = mx.rtc.PallasKernel("axpy", ["x", "y"], ["out"], """
+def axpy(x, y, out):
+    out[...] = 2.0 * x[...] + y[...]
+""")
+    x = mx.nd.array(np.ones((8, 128), np.float32))
+    y = mx.nd.array(np.full((8, 128), 3.0, np.float32))
+    out = k(x, y)
+    np.testing.assert_allclose(out.asnumpy(), np.full((8, 128), 5.0))
+
+
+def test_rtc_push_api():
+    k = mx.rtc.Rtc("scale", ["x"], ["y"], """
+def scale(x, y):
+    y[...] = x[...] * 10.0
+""")
+    x = mx.nd.array(np.arange(16, dtype=np.float32).reshape(2, 8))
+    y = mx.nd.zeros((2, 8))
+    k.push([x], [y], (1, 1, 1), (1, 1, 1))
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() * 10.0)
+
+
+def test_custom_op_stateful_forward_backward():
+    # regression: state saved on self in forward must be visible in
+    # backward (one operator instance per bound graph)
+    @mxop.register("stateful_scale")
+    class StatefulProp(mxop.CustomOpProp):
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class S(mxop.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.saved_scale = float(in_data[0].max()) or 1.0
+                    self.assign(out_data[0], req[0],
+                                in_data[0] / self.saved_scale)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                out_grad[0] / self.saved_scale)
+
+            return S()
+
+    x = mx.nd.array(np.array([[2.0, 4.0]], dtype=np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="stateful_scale")
+        loss = mx.nd.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               np.full((1, 2), 1 / 4.0), rtol=1e-6)
+
+
+def test_sequential_with_fused_cell_unroll():
+    # regression: fused 3-D states synthesized inside SequentialRNNCell
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.FusedRNNCell(8, num_layers=1, mode="lstm",
+                                  prefix="f_"))
+    stack.add(mx.rnn.LSTMCell(8, prefix="s_"))
+    out, states = stack.unroll(4, inputs=mx.sym.Variable("data"),
+                               merge_outputs=True)
+    ex = out.simple_bind(ctx=mx.cpu(), grad_req="null", data=(2, 4, 8))
+    rng = np.random.RandomState(0)
+    for n, a in ex.arg_dict.items():
+        a[:] = rng.uniform(-0.1, 0.1, a.shape).astype(np.float32)
+    ex.forward(is_train=False)
+    assert ex.outputs[0].shape == (2, 4, 8)
