@@ -1,0 +1,11 @@
+"""``mx.image`` — host-side image decode/augment pipeline
+(reference ``python/mxnet/image/image.py``)."""
+from .image import (Augmenter, BrightnessJitterAug, CastAug,
+                    CenterCropAug, ColorJitterAug, ColorNormalizeAug,
+                    ContrastJitterAug, CreateAugmenter, ForceResizeAug,
+                    HorizontalFlipAug, HueJitterAug, ImageIter,
+                    LightingAug, RandomCropAug, RandomGrayAug,
+                    RandomOrderAug, RandomSizedCropAug, ResizeAug,
+                    SaturationJitterAug, center_crop, color_normalize,
+                    fixed_crop, imdecode, imread, imresize, random_crop,
+                    random_size_crop, resize_short, scale_down)

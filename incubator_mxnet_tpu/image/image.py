@@ -1,0 +1,636 @@
+"""Image io + augmentation — ``mx.image``.
+
+Reference analog: ``python/mxnet/image/image.py`` (imread :44, imdecode
+:85, crop/resize helpers :139-480, Augmenter zoo :482-860,
+CreateAugmenter :861, ImageIter :975) and the C++ ``ImageRecordIter``
+pipeline it mirrors (``src/io/iter_image_recordio_2.cc``).
+
+TPU-native note: decode/augment is deliberately HOST-side numpy/OpenCV
+work — on a TPU system the input pipeline runs on the host CPU and only
+device-ready batches cross PCIe, exactly like the reference's
+multithreaded OpenCV parser fed pinned buffers to the GPU.  Augmented
+arrays stay numpy until batch assembly; the batch is one device_put.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random as pyrandom
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import io as io_mod
+from .. import ndarray as nd
+from .. import recordio
+from ..base import MXNetError
+
+try:
+    import cv2
+except ImportError:  # pragma: no cover - cv2 is in the image
+    cv2 = None
+
+__all__ = ["imdecode", "imread", "imresize", "scale_down", "resize_short",
+           "fixed_crop", "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "Augmenter", "ResizeAug", "ForceResizeAug",
+           "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
+           "RandomOrderAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
+           "LightingAug", "ColorNormalizeAug", "RandomGrayAug",
+           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter"]
+
+
+def _require_cv2():
+    if cv2 is None:
+        raise MXNetError("OpenCV (cv2) is required for mx.image")
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer to HWC ndarray (reference
+    ``image.py:85``; BGR→RGB like the reference's default)."""
+    _require_cv2()
+    img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8),
+                       cv2.IMREAD_COLOR if flag else
+                       cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError("cannot decode image")
+    if flag and to_rgb:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return nd.array(img)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read + decode an image file (reference ``image.py:44``)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=2):
+    """Resize to exactly (w, h)."""
+    _require_cv2()
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    out = cv2.resize(arr, (w, h),
+                     interpolation=_get_interp_method(interp))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out)
+
+
+def scale_down(src_size, size):
+    """Scale requested crop size down to fit the source
+    (reference ``image.py:139``)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def _get_interp_method(interp, sizes=()):
+    """Interp code → cv2 constant; 9=auto by scale, 10=random
+    (reference ``image.py:174``)."""
+    _require_cv2()
+    table = {0: cv2.INTER_NEAREST, 1: cv2.INTER_LINEAR,
+             2: cv2.INTER_AREA, 3: cv2.INTER_CUBIC,
+             4: cv2.INTER_LANCZOS4}
+    if interp == 9:
+        if sizes:
+            oh, ow, nh, nw = sizes
+            if nh > oh and nw > ow:
+                return table[2]
+            if nh < oh and nw < ow:
+                return table[3]
+            return table[1]
+        return table[2]
+    if interp == 10:
+        return table[pyrandom.randint(0, 4)]
+    if interp not in table:
+        raise MXNetError("unknown interpolation method %s" % interp)
+    return table[interp]
+
+
+def resize_short(src, size, interp=2):
+    """Resize the shorter edge to ``size`` (reference ``image.py:229``)."""
+    _require_cv2()
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    out = cv2.resize(arr, (new_w, new_h), interpolation=_get_interp_method(
+        interp, (h, w, new_h, new_w)))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop a fixed region, optionally resizing to ``size``
+    (reference ``image.py:291``)."""
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = cv2.resize(out, size, interpolation=_get_interp_method(
+            interp, (h, w, size[1], size[0])))
+        if out.ndim == 2:
+            out = out[:, :, None]
+    return nd.array(out)
+
+
+def random_crop(src, size, interp=2):
+    """Random crop of ``size`` (scaled down if needed); returns
+    (cropped, (x0, y0, w, h)) (reference ``image.py:323``)."""
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """Center crop (reference ``image.py:362``)."""
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """(src - mean) / std (reference ``image.py:411``)."""
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    arr = arr.astype(np.float32)
+    if mean is not None:
+        arr = arr - np.asarray(mean, dtype=np.float32)
+    if std is not None:
+        arr = arr / np.asarray(std, dtype=np.float32)
+    return nd.array(arr)
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random area+aspect crop (Inception-style)
+    (reference ``image.py:435``)."""
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    h, w = arr.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = pyrandom.uniform(min_area, 1.0) * area
+        new_ratio = pyrandom.uniform(*ratio)
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if pyrandom.random() < 0.5:
+            new_h, new_w = new_w, new_h
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+# ---------------------------------------------------------------------------
+# Augmenters
+# ---------------------------------------------------------------------------
+
+
+class Augmenter(object):
+    """Image augmenter base (reference ``image.py:482``)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in self._kwargs.items():
+            if isinstance(v, nd.NDArray):
+                self._kwargs[k] = v.asnumpy().tolist()
+            elif isinstance(v, np.ndarray):
+                self._kwargs[k] = v.tolist()
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [resize_short(src, self.size, self.interp)]
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [imresize(src, self.size[0], self.size[1], self.interp)]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [random_crop(src, self.size, self.interp)[0]]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, min_area, ratio, interp=2):
+        super().__init__(size=size, min_area=min_area, ratio=ratio,
+                         interp=interp)
+        self.size = size
+        self.min_area = min_area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return [random_size_crop(src, self.size, self.min_area,
+                                 self.ratio, self.interp)[0]]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [center_crop(src, self.size, self.interp)[0]]
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [x.dumps() for x in self.ts]]
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        srcs = [src]
+        for t in ts:
+            srcs = [img for s in srcs for img in t(s)]
+        return srcs
+
+
+def _jitter(src, alpha, mode):
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    arr = arr.astype(np.float32)
+    coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+    if mode == "brightness":
+        arr *= alpha
+    elif mode == "contrast":
+        gray = (arr * coef).sum(axis=2, keepdims=True)
+        arr = arr * alpha + gray.mean() * (1.0 - alpha)
+    elif mode == "saturation":
+        gray = (arr * coef).sum(axis=2, keepdims=True)
+        arr = arr * alpha + gray * (1.0 - alpha)
+    return nd.array(arr)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return [_jitter(src, alpha, "brightness")]
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        return [_jitter(src, alpha, "contrast")]
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        return [_jitter(src, alpha, "saturation")]
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]], dtype=np.float32)
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]], dtype=np.float32)
+
+    def __call__(self, src):
+        arr = src.asnumpy() if hasattr(src, "asnumpy") \
+            else np.asarray(src)
+        arr = arr.astype(np.float32)
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                      dtype=np.float32)
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        return [nd.array(np.dot(arr, t))]
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting noise (reference ``image.py`` LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd, eigval=eigval, eigvec=eigvec)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        arr = src.asnumpy() if hasattr(src, "asnumpy") \
+            else np.asarray(src)
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return [nd.array(arr.astype(np.float32) + rgb)]
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = np.asarray(mean, np.float32) \
+            if mean is not None else None
+        self.std = np.asarray(std, np.float32) if std is not None else None
+
+    def __call__(self, src):
+        return [color_normalize(src, self.mean, self.std)]
+
+
+class RandomGrayAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = np.array([[0.21, 0.21, 0.21],
+                             [0.72, 0.72, 0.72],
+                             [0.07, 0.07, 0.07]], dtype=np.float32)
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            arr = src.asnumpy() if hasattr(src, "asnumpy") \
+                else np.asarray(src)
+            src = nd.array(np.dot(arr.astype(np.float32), self.mat))
+        return [src]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            arr = src.asnumpy() if hasattr(src, "asnumpy") \
+                else np.asarray(src)
+            src = nd.array(arr[:, ::-1].copy())
+        return [src]
+
+
+class CastAug(Augmenter):
+    def __init__(self):
+        super().__init__(type="float32")
+
+    def __call__(self, src):
+        arr = src.asnumpy() if hasattr(src, "asnumpy") \
+            else np.asarray(src)
+        return [nd.array(arr.astype(np.float32))]
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False,
+                    rand_resize=False, rand_mirror=False, mean=None,
+                    std=None, brightness=0, contrast=0, saturation=0,
+                    hue=0, pca_noise=0, rand_gray=0, inter_method=2):
+    """Standard augmenter list (reference ``image.py:861``)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.08, (3.0 / 4.0,
+                                                            4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(io_mod.DataIter):
+    """Image iterator with pluggable augmenters, reading ``.rec`` packs
+    or an image list + root dir (reference ``image.py:975``)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0,
+                 num_parts=1, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or (isinstance(imglist, list))
+        if path_imgrec:
+            if path_imgidx:
+                self.imgrec = recordio.IndexedRecordIO(path_imgidx,
+                                                       path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+        else:
+            self.imgrec = None
+            self.imgidx = None
+
+        self.imglist = None
+        if path_imglist:
+            imglist = {}
+            imgkeys = []
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = np.array(
+                        [float(i) for i in parts[1:-1]], dtype=np.float32)
+                    key = int(parts[0])
+                    imglist[key] = (label, parts[-1])
+                    imgkeys.append(key)
+            self.imglist = imglist
+            self.seq = imgkeys
+        elif isinstance(imglist, list):
+            result = {}
+            imgkeys = []
+            index = 1
+            for img in imglist:
+                key = str(index)
+                index += 1
+                if isinstance(img[0], (list, np.ndarray)):
+                    label = np.array(img[0], dtype=np.float32)
+                else:
+                    label = np.array([img[0]], dtype=np.float32)
+                result[key] = (label, img[1])
+                imgkeys.append(str(key))
+            self.imglist = result
+            self.seq = imgkeys
+        elif self.imgidx is not None:
+            self.seq = self.imgidx
+        else:
+            self.seq = None
+
+        if num_parts > 1 and self.seq is not None:
+            assert part_index < num_parts
+            N = len(self.seq)
+            C = N // num_parts
+            self.seq = self.seq[part_index * C:(part_index + 1) * C]
+
+        self.path_root = path_root
+        assert len(data_shape) == 3 and data_shape[0] == 3 or \
+            data_shape[0] == 1
+        self.provide_data = [io_mod.DataDesc(data_name,
+                                             (batch_size,) + data_shape)]
+        if label_width > 1:
+            self.provide_label = [io_mod.DataDesc(
+                label_name, (batch_size, label_width))]
+        else:
+            self.provide_label = [io_mod.DataDesc(label_name,
+                                                  (batch_size,))]
+        self.data_shape = data_shape
+        self.label_width = label_width
+        self.shuffle = shuffle
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """Next (label, decoded image) pair."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                return header.label, img
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root, fname), "rb") as f:
+                img = f.read()
+            return label, img
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, c, h, w), dtype=np.float32)
+        label_shape = (batch_size, self.label_width) \
+            if self.label_width > 1 else (batch_size,)
+        batch_label = np.zeros(label_shape, dtype=np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                data = [imdecode(s)]
+                if data[0].shape[0] < self.data_shape[1] and \
+                        not self.auglist:
+                    raise MXNetError("image smaller than data_shape")
+                for aug in self.auglist:
+                    data = [ret for src in data for ret in aug(src)]
+                for d in data:
+                    if i >= batch_size:
+                        break
+                    arr = d.asnumpy() if hasattr(d, "asnumpy") \
+                        else np.asarray(d)
+                    batch_data[i] = arr.transpose(2, 0, 1)
+                    if self.label_width > 1:
+                        batch_label[i] = np.asarray(label)[
+                            :self.label_width]
+                    else:
+                        batch_label[i] = np.asarray(label).reshape(-1)[0]
+                    i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = batch_size - i
+        return io_mod.DataBatch([nd.array(batch_data)],
+                                [nd.array(batch_label)], pad=pad,
+                                provide_data=self.provide_data,
+                                provide_label=self.provide_label)
+
+    __next__ = next

@@ -426,3 +426,97 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO pack of encoded images → multithreaded decode/augment →
+    device-ready NCHW batches.
+
+    Reference analog: the C++ ``ImageRecordIter`` chain
+    (``src/io/iter_image_recordio_2.cc``: parser thread pool → batch
+    loader → normalize → prefetcher).  Host-side here by design: on TPU
+    systems input pipelines run on host CPU; ``preprocess_threads`` maps
+    to a thread pool (cv2 releases the GIL) and prefetching to a
+    background queue exactly like ``iter_prefetcher.h`` double-buffered.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, shuffle=False, mean_r=0.0, mean_g=0.0,
+                 mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
+                 rand_crop=False, rand_mirror=False, resize=0,
+                 preprocess_threads=4, prefetch_buffer=4, label_width=1,
+                 data_name="data", label_name="softmax_label",
+                 round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        from . import image as image_mod
+
+        mean = None
+        std = None
+        if mean_r or mean_g or mean_b:
+            mean = np.array([mean_r, mean_g, mean_b], dtype=np.float32)
+        if std_r != 1.0 or std_g != 1.0 or std_b != 1.0:
+            std = np.array([std_r, std_g, std_b], dtype=np.float32)
+
+        aug = image_mod.CreateAugmenter(
+            data_shape, resize=resize, rand_crop=rand_crop,
+            rand_mirror=rand_mirror, mean=mean, std=std)
+        self._scale = scale
+        self._inner = image_mod.ImageIter(
+            batch_size, data_shape, label_width=label_width,
+            path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+            shuffle=shuffle, aug_list=aug, data_name=data_name,
+            label_name=label_name)
+        self.provide_data = self._inner.provide_data
+        self.provide_label = self._inner.provide_label
+        self._threads = max(1, int(preprocess_threads))
+        self._prefetch = max(1, int(prefetch_buffer))
+        self._pool = None
+        self._queue = None
+        self._stop = False
+        self._start_prefetch()
+
+    # --- background prefetch (analog of iter_prefetcher.h) ---------------
+    def _start_prefetch(self):
+        import queue
+        import threading
+
+        self._queue = queue.Queue(maxsize=self._prefetch)
+        self._stop = False
+
+        def worker():
+            while not self._stop:
+                try:
+                    batch = self._inner.next()
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                if self._scale != 1.0:
+                    batch = DataBatch(
+                        [b * self._scale for b in batch.data],
+                        batch.label, pad=batch.pad,
+                        provide_data=batch.provide_data,
+                        provide_label=batch.provide_label)
+                self._queue.put(batch)
+
+        self._worker = threading.Thread(target=worker, daemon=True)
+        self._worker.start()
+
+    def reset(self):
+        self._stop = True
+        # drain so a blocked worker can exit
+        try:
+            while True:
+                self._queue.get_nowait()
+        except Exception:
+            pass
+        self._worker.join(timeout=5)
+        self._inner.reset()
+        self._start_prefetch()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    __next__ = next

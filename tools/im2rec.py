@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Pack an image folder / list file into RecordIO — ``tools/im2rec.py``.
+
+Reference analog: ``tools/im2rec.py`` (and the C++ ``tools/im2rec.cc``):
+makes a ``.lst`` listing (index\\tlabel\\tpath) and packs JPEG bytes into
+``.rec`` (+ ``.idx``) via the recordio container.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from incubator_mxnet_tpu import recordio  # noqa: E402
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_image(root, recursive=True):
+    """Yield (index, relpath, label) walking class-per-subdir layout."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                if os.path.splitext(fname)[1].lower() in EXTS:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            if os.path.isfile(fpath) and \
+                    os.path.splitext(fname)[1].lower() in EXTS:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            line = [i.strip() for i in line.strip().split("\t")]
+            if len(line) < 3:
+                continue
+            yield (int(line[0]), line[-1],
+                   *[float(i) for i in line[1:-1]])
+
+
+def make_list(args):
+    image_list = list(list_image(args.prefix, args.recursive))
+    image_list = [(i, rel, label) for i, rel, label in image_list]
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    N = len(image_list)
+    chunk_size = (N + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        if not chunk:
+            continue
+        str_chunk = ".%d" % i if args.chunks > 1 else ""
+        sep = int(chunk_size * args.train_ratio)
+        sep_test = int(chunk_size * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + "_val.lst",
+                           chunk[sep_test + sep:])
+            write_list(args.prefix + str_chunk + "_train.lst",
+                       chunk[sep_test:sep_test + sep])
+
+
+def image_encode(args, i, item, q_out):
+    """Read/re-encode one image into a packed record string."""
+    fullpath = os.path.join(args.root, item[1])
+    header = recordio.IRHeader(0, item[2] if len(item) == 3
+                               else np.array(item[2:], dtype=np.float32),
+                               item[0], 0)
+    if args.pass_through:
+        with open(fullpath, "rb") as fin:
+            img = fin.read()
+        s = recordio.pack(header, img)
+        q_out.append((i, s, item))
+        return
+    import cv2
+
+    img = cv2.imread(fullpath, args.color)
+    if img is None:
+        print("imread read blank (None) image for file: %s" % fullpath)
+        return
+    if args.center_crop:
+        if img.shape[0] > img.shape[1]:
+            margin = (img.shape[0] - img.shape[1]) // 2
+            img = img[margin:margin + img.shape[1], :]
+        else:
+            margin = (img.shape[1] - img.shape[0]) // 2
+            img = img[:, margin:margin + img.shape[0]]
+    if args.resize:
+        if img.shape[0] > img.shape[1]:
+            newsize = (args.resize,
+                       img.shape[0] * args.resize // img.shape[1])
+        else:
+            newsize = (img.shape[1] * args.resize // img.shape[0],
+                       args.resize)
+        img = cv2.resize(img, newsize)
+    s = recordio.pack_img(header, img, quality=args.quality,
+                          img_fmt=args.encoding)
+    q_out.append((i, s, item))
+
+
+def make_record(args, path_list):
+    """Pack all images from a .lst into .rec/.idx."""
+    image_list = list(read_list(path_list))
+    fname = os.path.basename(path_list)
+    fname_rec = os.path.splitext(fname)[0] + ".rec"
+    fname_idx = os.path.splitext(fname)[0] + ".idx"
+    record = recordio.IndexedRecordIO(
+        os.path.join(args.out_dir or os.path.dirname(path_list),
+                     fname_idx),
+        os.path.join(args.out_dir or os.path.dirname(path_list),
+                     fname_rec), "w")
+    q_out = []
+    for i, item in enumerate(image_list):
+        image_encode(args, i, item, q_out)
+    for i, s, item in q_out:
+        record.write_idx(item[0], s)
+    record.close()
+    print("packed %d records into %s" % (len(q_out), fname_rec))
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Create an image list / RecordIO pack")
+    parser.add_argument("prefix", help="prefix of .lst and .rec files")
+    parser.add_argument("root", help="root folder of images")
+    parser.add_argument("--list", action="store_true",
+                        help="make a list file instead of a record")
+    parser.add_argument("--exts", nargs="+", default=list(EXTS))
+    parser.add_argument("--chunks", type=int, default=1)
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--test-ratio", type=float, default=0)
+    parser.add_argument("--recursive", action="store_true", default=True)
+    parser.add_argument("--shuffle", type=bool, default=True)
+    parser.add_argument("--pass-through", action="store_true",
+                        help="skip transcoding, pack raw bytes")
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--center-crop", action="store_true")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--encoding", choices=[".jpg", ".png"],
+                        default=".jpg")
+    parser.add_argument("--color", type=int, default=1,
+                        choices=[-1, 0, 1])
+    parser.add_argument("--out-dir", default=None)
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.list:
+        make_list(args)
+    else:
+        lst = args.prefix if args.prefix.endswith(".lst") \
+            else args.prefix + ".lst"
+        if not os.path.isfile(lst):
+            # no list yet: build one on the fly
+            ns = argparse.Namespace(**vars(args))
+            ns.prefix = os.path.splitext(lst)[0]
+            make_list(ns)
+        make_record(args, lst)
+
+
+if __name__ == "__main__":
+    main()
