@@ -547,8 +547,9 @@ class ImageIter(io_mod.DataIter):
             self.seq = self.seq[part_index * C:(part_index + 1) * C]
 
         self.path_root = path_root
-        assert len(data_shape) == 3 and data_shape[0] == 3 or \
-            data_shape[0] == 1
+        assert len(data_shape) == 3 and data_shape[0] in (1, 3), \
+            "data_shape must be (c, h, w) with c in {1, 3}, got %s" \
+            % (data_shape,)
         self.provide_data = [io_mod.DataDesc(data_name,
                                              (batch_size,) + data_shape)]
         if label_width > 1:
@@ -584,6 +585,10 @@ class ImageIter(io_mod.DataIter):
             if self.imgrec is not None:
                 s = self.imgrec.read_idx(idx)
                 header, img = recordio.unpack(s)
+                # a user-supplied .lst relabels the record (reference
+                # image.py next_sample: imglist label wins over header)
+                if self.imglist is not None:
+                    return self.imglist[idx][0], img
                 return header.label, img
             label, fname = self.imglist[idx]
             with open(os.path.join(self.path_root, fname), "rb") as f:

@@ -476,40 +476,102 @@ class ImageRecordIter(DataIter):
         self._start_prefetch()
 
     # --- background prefetch (analog of iter_prefetcher.h) ---------------
+    def _decode_one(self, item):
+        """Decode + augment one raw record → list of CHW float arrays.
+
+        Runs on a pool worker; cv2 decode releases the GIL so
+        ``preprocess_threads`` workers scale like the reference's parser
+        thread pool (``iter_image_recordio_2.cc:46``).
+        """
+        from . import image as image_mod
+
+        label, s = item
+        data = [image_mod.imdecode(s)]
+        for aug in self._inner.auglist:
+            data = [ret for src in data for ret in aug(src)]
+        out = []
+        for d in data:
+            arr = d.asnumpy() if hasattr(d, "asnumpy") else np.asarray(d)
+            out.append(np.ascontiguousarray(
+                arr.transpose(2, 0, 1), dtype=np.float32))
+        return label, out
+
     def _start_prefetch(self):
         import queue
-        import threading
+        from multiprocessing.pool import ThreadPool
 
         self._queue = queue.Queue(maxsize=self._prefetch)
         self._stop = False
+        if self._pool is None:
+            self._pool = ThreadPool(self._threads)
+
+        inner = self._inner
 
         def worker():
-            while not self._stop:
-                try:
-                    batch = self._inner.next()
-                except StopIteration:
-                    self._queue.put(None)
-                    return
-                if self._scale != 1.0:
-                    batch = DataBatch(
-                        [b * self._scale for b in batch.data],
-                        batch.label, pad=batch.pad,
-                        provide_data=batch.provide_data,
-                        provide_label=batch.provide_label)
-                self._queue.put(batch)
+            bs = inner.batch_size
+            c, h, w = inner.data_shape
+            # decoded-but-unbatched outputs carry over between batches so
+            # multi-output augmenters lose no samples
+            carry = []
+            exhausted = False
+            try:
+                while not self._stop:
+                    while len(carry) < bs and not exhausted:
+                        raw = []
+                        try:
+                            while len(raw) < bs:
+                                raw.append(inner.next_sample())
+                        except StopIteration:
+                            exhausted = True
+                        for label, arrs in self._pool.map(
+                                self._decode_one, raw):
+                            carry.extend((label, a) for a in arrs)
+                    if not carry:
+                        self._queue.put(None)
+                        return
+                    take, carry = carry[:bs], carry[bs:]
+                    batch_data = np.zeros((bs, c, h, w),
+                                          dtype=np.float32)
+                    label_shape = (bs, inner.label_width) \
+                        if inner.label_width > 1 else (bs,)
+                    batch_label = np.zeros(label_shape,
+                                           dtype=np.float32)
+                    for i, (label, arr) in enumerate(take):
+                        batch_data[i] = arr
+                        if inner.label_width > 1:
+                            batch_label[i] = np.asarray(label)[
+                                :inner.label_width]
+                        else:
+                            batch_label[i] = np.asarray(
+                                label).reshape(-1)[0]
+                    if self._scale != 1.0:
+                        batch_data *= self._scale
+                    self._queue.put(DataBatch(
+                        [nd_array(batch_data)], [nd_array(batch_label)],
+                        pad=bs - len(take),
+                        provide_data=self.provide_data,
+                        provide_label=self.provide_label))
+                    if exhausted and not carry:
+                        self._queue.put(None)
+                        return
+            except Exception as exc:  # surface to the consumer, no hang
+                self._queue.put(exc)
 
         self._worker = threading.Thread(target=worker, daemon=True)
         self._worker.start()
 
     def reset(self):
+        import queue
+
         self._stop = True
-        # drain so a blocked worker can exit
-        try:
-            while True:
-                self._queue.get_nowait()
-        except Exception:
-            pass
-        self._worker.join(timeout=5)
+        # drain until the worker exits so it cannot race the next epoch's
+        # worker on the shared inner iterator
+        while self._worker.is_alive():
+            try:
+                self._queue.get(timeout=0.1)
+            except queue.Empty:
+                pass
+        self._worker.join()
         self._inner.reset()
         self._start_prefetch()
 
@@ -517,6 +579,8 @@ class ImageRecordIter(DataIter):
         batch = self._queue.get()
         if batch is None:
             raise StopIteration
+        if isinstance(batch, Exception):
+            raise batch
         return batch
 
     __next__ = next

@@ -21,8 +21,9 @@ from incubator_mxnet_tpu import recordio  # noqa: E402
 EXTS = (".jpg", ".jpeg", ".png", ".bmp")
 
 
-def list_image(root, recursive=True):
+def list_image(root, recursive=True, exts=EXTS):
     """Yield (index, relpath, label) walking class-per-subdir layout."""
+    exts = tuple(e.lower() for e in exts)
     i = 0
     if recursive:
         cat = {}
@@ -31,7 +32,7 @@ def list_image(root, recursive=True):
             files.sort()
             for fname in files:
                 fpath = os.path.join(path, fname)
-                if os.path.splitext(fname)[1].lower() in EXTS:
+                if os.path.splitext(fname)[1].lower() in exts:
                     if path not in cat:
                         cat[path] = len(cat)
                     yield (i, os.path.relpath(fpath, root), cat[path])
@@ -40,7 +41,7 @@ def list_image(root, recursive=True):
         for fname in sorted(os.listdir(root)):
             fpath = os.path.join(root, fname)
             if os.path.isfile(fpath) and \
-                    os.path.splitext(fname)[1].lower() in EXTS:
+                    os.path.splitext(fname)[1].lower() in exts:
                 yield (i, os.path.relpath(fpath, root), 0)
                 i += 1
 
@@ -66,8 +67,7 @@ def read_list(path_in):
 
 
 def make_list(args):
-    image_list = list(list_image(args.prefix, args.recursive))
-    image_list = [(i, rel, label) for i, rel, label in image_list]
+    image_list = list(list_image(args.root, args.recursive, args.exts))
     if args.shuffle:
         random.seed(100)
         random.shuffle(image_list)
@@ -162,8 +162,14 @@ def parse_args(argv=None):
     parser.add_argument("--chunks", type=int, default=1)
     parser.add_argument("--train-ratio", type=float, default=1.0)
     parser.add_argument("--test-ratio", type=float, default=0)
-    parser.add_argument("--recursive", action="store_true", default=True)
-    parser.add_argument("--shuffle", type=bool, default=True)
+    parser.add_argument("--recursive", dest="recursive",
+                        action="store_true", default=True)
+    parser.add_argument("--no-recursive", dest="recursive",
+                        action="store_false")
+    parser.add_argument("--shuffle", dest="shuffle", action="store_true",
+                        default=True)
+    parser.add_argument("--no-shuffle", dest="shuffle",
+                        action="store_false")
     parser.add_argument("--pass-through", action="store_true",
                         help="skip transcoding, pack raw bytes")
     parser.add_argument("--resize", type=int, default=0)
