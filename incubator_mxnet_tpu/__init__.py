@@ -38,6 +38,7 @@ _OPTIONAL = [
     ("registry", ()), ("profiler", ()), ("visualization", ("viz",)),
     ("test_utils", ()), ("parallel", ()), ("models", ()), ("gluon", ()),
     ("rnn", ()), ("image", ()), ("operator", ()), ("rtc", ()),
+    ("contrib", ()),
 ]
 
 import importlib as _importlib

@@ -1,0 +1,9 @@
+"""Experimental contributions (``python/mxnet/contrib/__init__.py``)."""
+from . import symbol
+from . import ndarray
+
+from . import symbol as sym
+from . import ndarray as nd
+
+from . import autograd
+from . import tensorboard

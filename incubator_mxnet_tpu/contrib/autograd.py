@@ -1,0 +1,15 @@
+"""Experimental autograd aliases (``python/mxnet/contrib/autograd.py``).
+
+The contrib module predates the stable ``mx.autograd``; it re-exports the
+same machinery under the old names.
+"""
+from ..autograd import (record as train_section,  # noqa: F401
+                        pause as test_section,  # noqa: F401
+                        mark_variables, backward,  # noqa: F401
+                        set_recording as set_is_training)  # noqa: F401
+
+
+def compute_gradient(outputs):
+    """Compute gradients of outputs w.r.t. marked variables
+    (contrib/autograd.py:50)."""
+    backward(outputs)

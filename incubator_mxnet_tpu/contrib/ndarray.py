@@ -1,0 +1,24 @@
+"""``mx.contrib.nd`` — contrib ops with the ``_contrib_`` prefix stripped.
+
+Reference analog: ``python/mxnet/contrib/ndarray.py``.
+"""
+from __future__ import annotations
+
+import sys
+
+from ..ops.registry import OPS
+from .. import ndarray as _ndarray
+
+
+def _install():
+    mod = sys.modules[__name__]
+    for key in OPS.keys():
+        if not key.startswith("_contrib_"):
+            continue
+        short = key[len("_contrib_"):]
+        fn = getattr(_ndarray, key, None)
+        if fn is not None and not hasattr(mod, short):
+            setattr(mod, short, fn)
+
+
+_install()

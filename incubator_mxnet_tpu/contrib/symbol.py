@@ -1,0 +1,24 @@
+"""``mx.contrib.sym`` — contrib ops with the ``_contrib_`` prefix stripped.
+
+Reference analog: ``python/mxnet/contrib/symbol.py`` (an empty namespace the
+C registry populates with every op whose name starts ``_contrib_``).
+"""
+from __future__ import annotations
+
+import sys
+
+from ..ops.registry import OPS
+from .. import symbol as _symbol
+
+
+def _install():
+    mod = sys.modules[__name__]
+    for key in OPS.keys():
+        if not key.startswith("_contrib_"):
+            continue
+        short = key[len("_contrib_"):]
+        if not hasattr(mod, short):
+            setattr(mod, short, getattr(_symbol, key))
+
+
+_install()

