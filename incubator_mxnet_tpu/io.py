@@ -578,8 +578,14 @@ class ImageRecordIter(DataIter):
     def next(self):
         batch = self._queue.get()
         if batch is None:
+            # keep the sentinel so repeated next() keeps raising rather
+            # than blocking on the dead worker
+            self._queue.put(None)
             raise StopIteration
         if isinstance(batch, Exception):
+            # the worker is dead; re-arm the queue so every subsequent
+            # next() fails fast instead of hanging
+            self._queue.put(batch)
             raise batch
         return batch
 
