@@ -9,3 +9,8 @@ from .image import (Augmenter, BrightnessJitterAug, CastAug,
                     SaturationJitterAug, center_crop, color_normalize,
                     fixed_crop, imdecode, imread, imresize, random_crop,
                     random_size_crop, resize_short, scale_down)
+from .detection import (DetAugmenter, DetBorrowAug, DetRandomSelectAug,
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        DetRandomPadAug, CreateMultiRandCropAugmenter,
+                        CreateDetAugmenter, ImageDetIter)
+from . import detection as det

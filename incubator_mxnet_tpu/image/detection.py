@@ -1,0 +1,475 @@
+"""Detection image iterator + box-aware augmenters.
+
+Reference analogs: ``python/mxnet/image/detection.py`` (ImageDetIter,
+CreateDetAugmenter, the DetAugmenter family) and the C++ det pipeline
+(``src/io/iter_image_det_recordio.cc:596``, ``image_det_aug_default.cc``).
+
+Label wire format (image_det_aug_default.cc:248-281 ``ImageDetLabel``):
+``[header_width, object_width, <extra header...>,
+(id, xmin, ymin, xmax, ymax, <extra...>) * N]`` with normalized [0,1]
+corner coordinates.  Batched labels are padded with -1 rows to the
+estimated max object count, which is what ``_contrib_MultiBoxTarget``
+consumes.
+"""
+from __future__ import annotations
+
+import logging
+import random as pyrandom
+from math import sqrt
+
+import numpy as np
+
+from .. import io as io_mod
+from .. import ndarray as nd
+from ..base import MXNetError
+from .image import (Augmenter, CastAug, ColorJitterAug, ColorNormalizeAug,
+                    ForceResizeAug, HueJitterAug, LightingAug, RandomGrayAug,
+                    ResizeAug, fixed_crop, imdecode, ImageIter)
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
+           "ImageDetIter"]
+
+
+def _box_areas(boxes):
+    """Areas of normalized (N, 4) corner boxes, degenerate -> 0."""
+    return (np.maximum(0, boxes[:, 2] - boxes[:, 0])
+            * np.maximum(0, boxes[:, 3] - boxes[:, 1]))
+
+
+def _to_np(src):
+    """Coerce NDArray/array-like to a host numpy HWC image.  The pad/flip
+    augmenters do raw numpy indexing; feeding them a device NDArray would
+    fall into numpy's element-wise iteration path."""
+    return src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+
+
+class DetAugmenter(object):
+    """Base detection augmenter: ``(image, label) -> (image, label)``."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift a plain (image-only) augmenter into the detection chain."""
+
+    def __init__(self, augmenter):
+        super().__init__(augmenter=augmenter.dumps()
+                         if isinstance(augmenter, Augmenter) else str(augmenter))
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        out = self.augmenter(src)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return out, label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly run one augmenter from a list (or none with skip_prob)."""
+
+    def __init__(self, aug_list, skip_prob=0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if not self.aug_list or pyrandom.random() < self.skip_prob:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image + labels with probability p."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = _to_np(src)[:, ::-1]
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Constrained random crop (image/detection.py:150-320 semantics):
+    sample a crop satisfying aspect/area constraints and
+    ``min_object_covered``; project labels into the crop and eject objects
+    whose surviving area fraction is below ``min_eject_coverage``."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.enabled = (0 < area_range[0] <= area_range[1]
+                        and 0 < aspect_ratio_range[0] <= aspect_ratio_range[1]
+                        and area_range[1] > 0)
+
+    def _project(self, label, x, y, w, h, height, width):
+        """Labels into normalized crop coords; None if all ejected."""
+        nx, ny = x / width, y / height
+        nw, nh = w / width, h / height
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] - nx) / nw
+        out[:, (2, 4)] = (out[:, (2, 4)] - ny) / nh
+        out[:, 1:5] = np.clip(out[:, 1:5], 0.0, 1.0)
+        old_area = _box_areas(label[:, 1:5])
+        new_area = _box_areas(out[:, 1:5]) * nw * nh
+        with np.errstate(divide="ignore", invalid="ignore"):
+            coverage = np.where(old_area > 0, new_area / old_area, 0.0)
+        keep = ((out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2])
+                & (coverage > self.min_eject_coverage))
+        if not keep.any():
+            return None
+        return out[keep]
+
+    def _satisfies(self, label, x, y, w, h, height, width):
+        if w * h < 2:
+            return False
+        x1, y1 = x / width, y / height
+        x2, y2 = (x + w) / width, (y + h) / height
+        areas = _box_areas(label[:, 1:5])
+        valid = areas * width * height > 2
+        if not valid.any():
+            return False
+        b = label[valid, 1:5]
+        il = np.maximum(b[:, 0], x1)
+        it = np.maximum(b[:, 1], y1)
+        ir = np.minimum(b[:, 2], x2)
+        ib = np.minimum(b[:, 3], y2)
+        inter = np.where((il < ir) & (it < ib), (ir - il) * (ib - it), 0.0)
+        cov = inter / areas[valid]
+        cov = cov[cov > 0]
+        return cov.size > 0 and cov.min() > self.min_object_covered
+
+    def __call__(self, src, label):
+        height, width = src.shape[:2]
+        if not self.enabled or height <= 0 or width <= 0:
+            return src, label
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            h = int(round(sqrt(min_area / ratio)))
+            max_h = min(int(round(sqrt(max_area / ratio))),
+                        int(width / ratio), height)
+            if h > max_h:
+                h = max_h
+            if h < max_h:
+                h = pyrandom.randint(h, max_h)
+            w = int(round(h * ratio))
+            if (w * h < min_area or w * h > max_area or w > width
+                    or h > height or w <= 0 or h <= 0):
+                continue
+            y = pyrandom.randint(0, max(0, height - h))
+            x = pyrandom.randint(0, max(0, width - w))
+            if self._satisfies(label, x, y, w, h, height, width):
+                new_label = self._project(label, x, y, w, h, height, width)
+                if new_label is not None:
+                    return fixed_crop(src, x, y, w, h, None), new_label
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion padding: place the image inside a larger canvas
+    filled with ``pad_val`` and rescale labels accordingly."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33), area_range=(1.0, 3.0),
+                 max_attempts=50, pad_val=(128, 128, 128)):
+        if not isinstance(pad_val, (list, tuple)):
+            pad_val = (pad_val,) * 3
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.pad_val = pad_val
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.enabled = (area_range[1] > 1.0
+                        and area_range[0] <= area_range[1]
+                        and 0 < aspect_ratio_range[0] <= aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        src = _to_np(src)
+        height, width = src.shape[:2]
+        if not self.enabled or height <= 0 or width <= 0:
+            return src, label
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            h = int(round(sqrt(min_area / ratio)))
+            max_h = int(round(sqrt(max_area / ratio)))
+            if round(h * ratio) < width:
+                h = int((width + 0.499999) / ratio)
+            h = min(max(h, height), max_h)
+            if h < max_h:
+                h = pyrandom.randint(h, max_h)
+            w = int(round(h * ratio))
+            if (h - height) < 2 or (w - width) < 2:
+                continue
+            y = pyrandom.randint(0, max(0, h - height))
+            x = pyrandom.randint(0, max(0, w - width))
+            canvas = np.empty((h, w, src.shape[2]), dtype=src.dtype)
+            canvas[:] = np.asarray(self.pad_val, dtype=src.dtype)
+            canvas[y:y + height, x:x + width] = src
+            out = label.copy()
+            out[:, (1, 3)] = (out[:, (1, 3)] * width + x) / w
+            out[:, (2, 4)] = (out[:, (2, 4)] * height + y) / h
+            return canvas, out
+        return src, label
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0):
+    """Bundle several DetRandomCropAug variants behind one random select
+    (image/detection.py:417-480); scalar params broadcast to the longest
+    list."""
+    params = [min_object_covered, aspect_ratio_range, area_range,
+              min_eject_coverage, max_attempts]
+    lists = [p if isinstance(p, list) else [p] for p in params]
+    n = max(len(p) for p in lists)
+    lists = [p * n if len(p) == 1 else p for p in lists]
+    for p in lists:
+        assert len(p) == n, "parameter list length mismatch"
+    augs = [DetRandomCropAug(min_object_covered=moc, aspect_ratio_range=arr,
+                             area_range=ar, min_eject_coverage=mec,
+                             max_attempts=ma)
+            for moc, arr, ar, mec, ma in zip(*lists)]
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 3.0),
+                       min_eject_coverage=0.3, max_attempts=50,
+                       pad_val=(127, 127, 127)):
+    """Standard SSD augmentation chain (image/detection.py:482-622)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        auglist.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range,
+            (area_range[0], min(1.0, area_range[1])), min_eject_coverage,
+            max_attempts, skip_prob=(1 - rand_crop)))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        auglist.append(DetRandomSelectAug(
+            [DetRandomPadAug(aspect_ratio_range, (1.0, area_range[1]),
+                             max_attempts, pad_val)], 1 - rand_pad))
+    auglist.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator producing (B, C, H, W) images and padded
+    (B, max_objects, object_width) labels (image/detection.py:624).
+
+    Unlabeled slots are filled with -1, the convention
+    ``_contrib_MultiBoxTarget`` expects for padded ground truths.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="label",
+                 **kwargs):
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, path_imgidx=path_imgidx,
+                         shuffle=shuffle, part_index=part_index,
+                         num_parts=num_parts, aug_list=[], imglist=imglist,
+                         data_name=data_name, label_name=label_name)
+        if aug_list is None:
+            self.auglist = CreateDetAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        label_shape = self._estimate_label_shape()
+        self.label_name = label_name
+        self.label_shape = label_shape
+        self.provide_label = [io_mod.DataDesc(
+            label_name, (self.batch_size,) + label_shape)]
+
+    # --- label plumbing ---------------------------------------------------
+    def _parse_label(self, label):
+        """Raw header+objects array -> (N, object_width) valid objects."""
+        raw = np.asarray(label).ravel()
+        if raw.size < 7:
+            raise MXNetError("Label shape is invalid: %s" % (raw.shape,))
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if (raw.size - header_width) % obj_width != 0:
+            raise MXNetError(
+                "Label shape %s inconsistent with annotation width %d"
+                % (raw.shape, obj_width))
+        out = raw[header_width:].reshape(-1, obj_width)
+        valid = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2])
+        if not valid.any():
+            raise MXNetError("Encountered sample with no valid label.")
+        return out[valid].astype(np.float32)
+
+    def _check_valid_label(self, label):
+        if label.ndim != 2 or label.shape[1] < 5:
+            raise MXNetError("Label with shape (1+, 5+) required, got %s"
+                             % (label.shape,))
+        ok = ((label[:, 0] >= 0) & (label[:, 3] > label[:, 1])
+              & (label[:, 4] > label[:, 2]))
+        if not ok.any():
+            raise MXNetError("Invalid label occurs.")
+
+    def _estimate_label_shape(self):
+        max_count, width = 0, 5
+        self.reset()
+        try:
+            while True:
+                label, _ = self.next_sample()
+                label = self._parse_label(label)
+                max_count = max(max_count, label.shape[0])
+                width = label.shape[1]
+        except StopIteration:
+            pass
+        self.reset()
+        return (max_count, width)
+
+    def reshape(self, data_shape=None, label_shape=None):
+        if data_shape is not None:
+            self.provide_data = [io_mod.DataDesc(
+                self.provide_data[0].name, (self.batch_size,) + data_shape)]
+            self.data_shape = data_shape
+        if label_shape is not None:
+            self.check_label_shape(label_shape)
+            self.provide_label = [io_mod.DataDesc(
+                self.provide_label[0].name,
+                (self.batch_size,) + label_shape)]
+            self.label_shape = label_shape
+
+    def check_label_shape(self, label_shape):
+        if len(label_shape) != 2:
+            raise ValueError("label_shape should have length 2")
+        if label_shape[0] < self.label_shape[0]:
+            raise ValueError(
+                "Attempts to reduce label count from %d to %d, not allowed"
+                % (self.label_shape[0], label_shape[0]))
+        if label_shape[1] != self.label_shape[1]:
+            raise ValueError("label_shape object width inconsistent: "
+                             "%d vs %d" % (self.label_shape[1],
+                                           label_shape[1]))
+
+    def sync_label_shape(self, it, verbose=False):
+        """Grow both iterators' label pads to the common max object count."""
+        assert isinstance(it, ImageDetIter)
+        assert self.label_shape[1] == it.label_shape[1], \
+            "object width mismatch"
+        max_count = max(self.label_shape[0], it.label_shape[0])
+        if max_count > self.label_shape[0]:
+            self.reshape(None, (max_count, self.label_shape[1]))
+        if max_count > it.label_shape[0]:
+            it.reshape(None, (max_count, it.label_shape[1]))
+        if verbose:
+            logging.info("Resized label_shape to (%d, %d).", max_count,
+                         self.label_shape[1])
+        return it
+
+    def augmentation_transform(self, data, label):
+        for aug in self.auglist:
+            data, label = aug(data, label)
+        return data, label
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, c, h, w), dtype=np.float32)
+        batch_label = np.full((batch_size,) + self.label_shape, -1.0,
+                              dtype=np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                data = imdecode(s)
+                try:
+                    label = self._parse_label(label)
+                    data, label = self.augmentation_transform(data, label)
+                    self._check_valid_label(label)
+                except MXNetError as e:
+                    logging.debug("Invalid sample, skipping: %s", e)
+                    continue
+                arr = data.asnumpy() if hasattr(data, "asnumpy") \
+                    else np.asarray(data)
+                batch_data[i] = arr.transpose(2, 0, 1)
+                num_obj = min(label.shape[0], self.label_shape[0])
+                batch_label[i, :num_obj] = label[:num_obj]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        return io_mod.DataBatch([nd.array(batch_data)],
+                                [nd.array(batch_label)],
+                                pad=batch_size - i,
+                                provide_data=self.provide_data,
+                                provide_label=self.provide_label)
+
+    __next__ = next

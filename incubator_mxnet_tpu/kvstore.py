@@ -53,6 +53,9 @@ class KVStore:
         self._store: Dict[Any, NDArray] = {}
         self._updater: Optional[Callable] = None
         self._optimizer = None
+        # jitted psum reducers keyed by (shape, dtype, device tuple) — the
+        # CommDevice merge-buffer analog, compiled once per key signature
+        self._psum_cache: Dict[tuple, Callable] = {}
 
     # ------------------------------------------------------------------ api
     def init(self, key, value) -> None:
@@ -90,23 +93,37 @@ class KVStore:
 
     # ------------------------------------------------------------ reduction
     def _reduce(self, vlist: List[NDArray]) -> NDArray:
-        """Sum a list of per-device gradients.
+        """Sum a list of per-device gradients as ONE XLA collective.
 
-        ``device`` semantics: arrays may live on different mesh devices; jax
-        resolves cross-device adds via ICI transfers, and inside a jit step
-        the same reduction lowers to one XLA all-reduce.
+        ``device`` semantics redesign of ``CommDevice`` (comm.h:222-343):
+        instead of P2P gather-scatter onto a merge GPU, the shards are
+        assembled into a global array over a 1-d mesh of the contributing
+        devices and reduced by a jitted ``shard_map`` ``lax.psum`` — one
+        all-reduce riding ICI, with the result replicated on every device so
+        the subsequent ``pull`` broadcast is free.  Falls back to a staged
+        add when shards share a device (the ``local`` type or CPU tests).
         """
         if len(vlist) == 1:
             return vlist[0]
         import jax
 
-        # stage onto the merge device (CommCPU pinned-buffer copy /
-        # CommDevice merge-buffer analog), then tree-sum
-        dev = next(iter(vlist[0].data.devices()))
-        acc = vlist[0].data
-        for v in vlist[1:]:
-            acc = acc + jax.device_put(v.data, dev)
-        return NDArray(acc, ctx=vlist[0]._ctx)
+        devs = [next(iter(v.data.devices())) for v in vlist]
+        if len(set(devs)) != len(devs):
+            # duplicated devices (e.g. all on one chip): plain fused add
+            acc = vlist[0].data
+            dev = devs[0]
+            for v in vlist[1:]:
+                acc = acc + jax.device_put(v.data, dev)
+            return NDArray(acc, ctx=vlist[0]._ctx)
+
+        arr0 = vlist[0].data
+        sig = (tuple(arr0.shape), str(arr0.dtype), tuple(id(d) for d in devs))
+        fn = self._psum_cache.get(sig)
+        if fn is None:
+            fn = _build_psum(devs, arr0.shape, arr0.dtype)
+            self._psum_cache[sig] = fn
+        out_shards = fn([v.data for v in vlist])
+        return NDArray(out_shards, ctx=vlist[0]._ctx)
 
     # ------------------------------------------------------------ optimizer
     def set_optimizer(self, optimizer) -> None:
@@ -232,6 +249,47 @@ class DistKVStore(KVStore):
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
+
+
+def _build_psum(devices, shape, dtype):
+    """Compile a one-collective all-reduce over ``devices``.
+
+    Returns ``fn(list_of_per_device_arrays) -> replicated jax.Array``.
+    The input shards form a (N, *shape) global array sharded on axis 0 of a
+    1-d mesh; ``shard_map(lax.psum)`` reduces it to a fully-replicated
+    result in a single XLA program (ICI all-reduce on a TPU mesh).
+    """
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    mesh = Mesh(_np.asarray(devices), ("dev",))
+    in_sharding = NamedSharding(mesh, P("dev"))
+    n = len(devices)
+
+    @jax.jit
+    def reduce_fn(x):
+        return shard_map(
+            lambda s: jax.lax.psum(s[0], "dev"), mesh=mesh,
+            in_specs=P("dev"), out_specs=P())(x)
+
+    def fn(shards):
+        global_shape = (n,) + tuple(shape)
+        arrs = [jax.device_put(s.reshape((1,) + tuple(shape)), d)
+                for s, d in zip(shards, devices)]
+        x = jax.make_array_from_single_device_arrays(
+            global_shape, in_sharding, arrs)
+        out = reduce_fn(x)
+        # the result is replicated on every contributing device; hand back
+        # the zero-copy local shard on the first device (the "merge device"
+        # the updater then runs on, comm.h:344 round-robin analog)
+        for shard in out.addressable_shards:
+            if shard.device == devices[0]:
+                return shard.data
+        return out.addressable_shards[0].data
+
+    return fn
 
 
 def _key_value(key, value):

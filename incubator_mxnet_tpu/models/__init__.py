@@ -12,13 +12,17 @@ from .resnet import get_symbol as resnet
 from .vgg import get_symbol as vgg
 from .inception_bn import get_symbol as inception_bn
 from .lstm_ptb import get_symbol as lstm_ptb, lstm_ptb_sym_gen
+from .ssd import ssd_300, get_symbol_train as ssd_train, \
+    get_symbol as ssd_deploy
 
 __all__ = ["lenet", "mlp", "alexnet", "resnet", "vgg", "inception_bn",
-           "lstm_ptb", "lstm_ptb_sym_gen", "get_symbol"]
+           "lstm_ptb", "lstm_ptb_sym_gen", "ssd_300", "ssd_train",
+           "ssd_deploy", "get_symbol"]
 
 _ZOO = {"lenet": lenet, "mlp": mlp, "alexnet": alexnet, "resnet": resnet,
         "vgg": vgg, "inception-bn": inception_bn,
-        "inception_bn": inception_bn, "lstm_ptb": lstm_ptb}
+        "inception_bn": inception_bn, "lstm_ptb": lstm_ptb,
+        "ssd_300": ssd_300, "ssd": ssd_300}
 
 
 def get_symbol(network: str, **kwargs):

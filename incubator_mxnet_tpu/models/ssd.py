@@ -1,0 +1,216 @@
+"""SSD-VGG16 detector (BASELINE config 4).
+
+Reference analogs: ``example/ssd/symbol/vgg16_reduced.py`` (base network),
+``example/ssd/symbol/common.py:96-300`` (multi-layer features + multibox
+heads), ``example/ssd/symbol/symbol_builder.py:29-160`` (train/deploy
+symbols), ``example/ssd/symbol/symbol_factory.py:22-60`` (ssd300 config).
+
+The training head wires ``_contrib_MultiBoxTarget`` → SoftmaxOutput (class
+loss with ignore) + smooth-L1 MakeLoss (location loss); the deploy head
+ends in ``_contrib_MultiBoxDetection``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import symbol as sym
+from ..contrib import symbol as contrib_sym
+
+__all__ = ["vgg16_reduced", "get_symbol_train", "get_symbol", "ssd_300"]
+
+
+def vgg16_reduced():
+    """VGG16 with fc6/fc7 as (dilated) convolutions, SSD flavor
+    (vgg16_reduced.py:20-95).  Returns the relu7 feature symbol."""
+    data = sym.Variable("data")
+    body = data
+    # (num convs, channels) per stage; pool3 uses ceil-mode in caffe SSD —
+    # XLA pooling is floor-mode, identical for the 300x300 config's shapes
+    cfg = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+    for i, (n, f) in enumerate(cfg):
+        for j in range(n):
+            body = sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
+                                   num_filter=f,
+                                   name="conv%d_%d" % (i + 1, j + 1))
+            body = sym.Activation(body, act_type="relu",
+                                  name="relu%d_%d" % (i + 1, j + 1))
+        if i < 4:
+            body = sym.Pooling(body, pool_type="max", kernel=(2, 2),
+                               stride=(2, 2), name="pool%d" % (i + 1))
+    body = sym.Pooling(body, pool_type="max", kernel=(3, 3), stride=(1, 1),
+                       pad=(1, 1), name="pool5")
+    body = sym.Convolution(body, kernel=(3, 3), pad=(6, 6), dilate=(6, 6),
+                           num_filter=1024, name="fc6")
+    body = sym.Activation(body, act_type="relu", name="relu6")
+    body = sym.Convolution(body, kernel=(1, 1), num_filter=1024, name="fc7")
+    body = sym.Activation(body, act_type="relu", name="relu7")
+    return body
+
+
+# ssd300 config (symbol_factory.py:36-46)
+_SSD300 = dict(
+    from_layers=["relu4_3", "relu7", "", "", "", ""],
+    num_filters=[512, -1, 512, 256, 256, 256],
+    strides=[-1, -1, 2, 2, 1, 1],
+    pads=[-1, -1, 1, 1, 0, 0],
+    sizes=[[0.1, 0.141], [0.2, 0.272], [0.37, 0.447], [0.54, 0.619],
+           [0.71, 0.79], [0.88, 0.961]],
+    ratios=[[1, 2, 0.5], [1, 2, 0.5, 3, 1.0 / 3], [1, 2, 0.5, 3, 1.0 / 3],
+            [1, 2, 0.5, 3, 1.0 / 3], [1, 2, 0.5], [1, 2, 0.5]],
+    normalizations=[20, -1, -1, -1, -1, -1],
+    steps=[x / 300.0 for x in (8, 16, 32, 64, 100, 300)],
+)
+
+
+def _conv_act(layer, name, num_filter, kernel, pad, stride):
+    c = sym.Convolution(layer, kernel=kernel, pad=pad, stride=stride,
+                        num_filter=num_filter, name="%s_conv" % name)
+    return sym.Activation(c, act_type="relu", name="%s_relu" % name)
+
+
+def multi_layer_feature(body, from_layers, num_filters, strides, pads,
+                        min_filter=128):
+    """Pick feature maps out of the base net and grow extra 1x1→3x3 stride-2
+    pyramids on top (common.py:96-152)."""
+    internals = body.get_internals()
+    layers = []
+    for k, (from_layer, num_filter, s, p) in enumerate(
+            zip(from_layers, num_filters, strides, pads)):
+        if from_layer.strip():
+            layers.append(internals[from_layer.strip() + "_output"])
+        else:
+            layer = layers[-1]
+            num_1x1 = max(min_filter, num_filter // 2)
+            c1 = _conv_act(layer, "multi_feat_%d_conv_1x1" % k, num_1x1,
+                           (1, 1), (0, 0), (1, 1))
+            c3 = _conv_act(c1, "multi_feat_%d_conv_3x3" % k, num_filter,
+                           (3, 3), (p, p), (s, s))
+            layers.append(c3)
+    return layers
+
+
+def multibox_layer(from_layers, num_classes, sizes, ratios, normalization,
+                   num_channels, clip=False, steps=()):
+    """Per-scale loc/cls conv heads + anchors, concatenated
+    (common.py:153-300).  ``num_classes`` here EXCLUDES background; one
+    background class is prepended, label 0."""
+    assert num_classes > 0
+    n = len(from_layers)
+    if not isinstance(ratios[0], (list, tuple)):
+        ratios = [ratios] * n
+    if not isinstance(normalization, (list, tuple)):
+        normalization = [normalization] * n
+    num_channels = list(num_channels)
+    num_classes += 1  # background = class 0
+    loc_layers, cls_layers, anchor_layers = [], [], []
+    for k, from_layer in enumerate(from_layers):
+        from_name = from_layer.name
+        if normalization[k] > 0:
+            from_layer = sym.L2Normalization(from_layer, mode="channel",
+                                             name="%s_norm" % from_name)
+            scale = sym.Variable(
+                "%s_scale" % from_name,
+                shape=(1, num_channels.pop(0), 1, 1),
+                init="[\"constant\", {\"value\": %f}]" % normalization[k],
+                wd_mult=0.1)
+            from_layer = sym.broadcast_mul(scale, from_layer)
+        size, ratio = sizes[k], ratios[k]
+        num_anchors = len(size) - 1 + len(ratio)
+
+        loc_pred = sym.Convolution(
+            from_layer, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+            num_filter=num_anchors * 4,
+            name="%s_loc_pred_conv" % from_name)
+        loc_pred = sym.transpose(loc_pred, axes=(0, 2, 3, 1))
+        loc_layers.append(sym.Flatten(loc_pred))
+
+        cls_pred = sym.Convolution(
+            from_layer, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+            num_filter=num_anchors * num_classes,
+            name="%s_cls_pred_conv" % from_name)
+        cls_pred = sym.transpose(cls_pred, axes=(0, 2, 3, 1))
+        cls_layers.append(sym.Flatten(cls_pred))
+
+        step = (steps[k], steps[k]) if steps else (-1.0, -1.0)
+        anchors = contrib_sym.MultiBoxPrior(
+            from_layer, sizes=str(tuple(size)), ratios=str(tuple(ratio)),
+            clip=clip, steps=str(step), name="%s_anchors" % from_name)
+        anchor_layers.append(sym.Flatten(anchors))
+
+    loc_preds = sym.Concat(*loc_layers, dim=1, name="multibox_loc_pred")
+    cls_preds = sym.Concat(*cls_layers, dim=1)
+    cls_preds = sym.Reshape(cls_preds, shape=(0, -1, num_classes))
+    cls_preds = sym.transpose(cls_preds, axes=(0, 2, 1),
+                              name="multibox_cls_pred")
+    anchor_boxes = sym.Concat(*anchor_layers, dim=1)
+    anchor_boxes = sym.Reshape(anchor_boxes, shape=(0, -1, 4),
+                               name="multibox_anchors")
+    return loc_preds, cls_preds, anchor_boxes
+
+
+def get_symbol_train(num_classes=20, nms_thresh=0.5, force_suppress=False,
+                     nms_topk=400, **config):
+    """SSD training symbol: Group([cls_prob, loc_loss, cls_label, det])
+    (symbol_builder.py:29-117)."""
+    cfg = dict(_SSD300)
+    cfg.update(config)
+    label = sym.Variable("label")
+    body = vgg16_reduced()
+    layers = multi_layer_feature(body, cfg["from_layers"],
+                                 cfg["num_filters"], cfg["strides"],
+                                 cfg["pads"])
+    loc_preds, cls_preds, anchor_boxes = multibox_layer(
+        layers, num_classes, cfg["sizes"], cfg["ratios"],
+        cfg["normalizations"], cfg["num_filters"], clip=False,
+        steps=cfg["steps"])
+
+    tmp = contrib_sym.MultiBoxTarget(
+        anchor_boxes, label, cls_preds, overlap_threshold=0.5,
+        ignore_label=-1, negative_mining_ratio=3,
+        minimum_negative_samples=0, negative_mining_thresh=0.5,
+        variances="(0.1, 0.1, 0.2, 0.2)", name="multibox_target")
+    loc_target, loc_target_mask, cls_target = tmp[0], tmp[1], tmp[2]
+
+    cls_prob = sym.SoftmaxOutput(cls_preds, cls_target, ignore_label=-1,
+                                 use_ignore=True, grad_scale=1.0,
+                                 multi_output=True, normalization="valid",
+                                 name="cls_prob")
+    loc_loss_ = sym.smooth_l1(loc_target_mask * (loc_preds - loc_target),
+                              scalar=1.0, name="loc_loss_")
+    loc_loss = sym.MakeLoss(loc_loss_, grad_scale=1.0,
+                            normalization="valid", name="loc_loss")
+    cls_label = sym.MakeLoss(cls_target, grad_scale=0, name="cls_label")
+    det = contrib_sym.MultiBoxDetection(
+        cls_prob, loc_preds, anchor_boxes, name="detection",
+        nms_threshold=nms_thresh, force_suppress=force_suppress,
+        variances="(0.1, 0.1, 0.2, 0.2)", nms_topk=nms_topk)
+    det = sym.MakeLoss(det, grad_scale=0, name="det_out")
+    return sym.Group([cls_prob, loc_loss, cls_label, det])
+
+
+def get_symbol(num_classes=20, nms_thresh=0.5, force_suppress=False,
+               nms_topk=400, **config):
+    """SSD inference symbol ending in MultiBoxDetection
+    (symbol_builder.py:118-160)."""
+    cfg = dict(_SSD300)
+    cfg.update(config)
+    body = vgg16_reduced()
+    layers = multi_layer_feature(body, cfg["from_layers"],
+                                 cfg["num_filters"], cfg["strides"],
+                                 cfg["pads"])
+    loc_preds, cls_preds, anchor_boxes = multibox_layer(
+        layers, num_classes, cfg["sizes"], cfg["ratios"],
+        cfg["normalizations"], cfg["num_filters"], clip=False,
+        steps=cfg["steps"])
+    cls_prob = sym.softmax(cls_preds, axis=1, name="cls_prob")
+    return contrib_sym.MultiBoxDetection(
+        cls_prob, loc_preds, anchor_boxes, name="detection",
+        nms_threshold=nms_thresh, force_suppress=force_suppress,
+        variances="(0.1, 0.1, 0.2, 0.2)", nms_topk=nms_topk)
+
+
+def ssd_300(num_classes=20, train=True, **kwargs):
+    """Convenience entry matching ``symbol_factory.get_symbol*('vgg16_reduced',
+    300, ...)``."""
+    fn = get_symbol_train if train else get_symbol
+    return fn(num_classes=num_classes, **kwargs)

@@ -95,7 +95,37 @@ _unary("erfinv", jax.lax.erf_inv)
 _unary("logical_not", lambda x: (x == 0).astype(x.dtype))
 _unary("ones_like", jnp.ones_like)
 _unary("zeros_like", jnp.zeros_like)
-_unary("make_loss", lambda x: x, aliases=["MakeLoss"])
+@register("make_loss", arg_names=["data"], aliases=["MakeLoss"])
+def _make_loss(ins, attrs, ctx):
+    """Loss head: forward identity; backward emits
+    ``grad_scale / norm`` regardless of the incoming gradient, where norm is
+    1 (null), batch size (batch), or #elements > valid_thresh (valid) —
+    ``src/operator/make_loss-inl.h:91-118``."""
+    grad_scale = parse_float(attrs.get("grad_scale", 1.0))
+    normalization = attrs.get("normalization", "null")
+    valid_thresh = parse_float(attrs.get("valid_thresh", 0.0))
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def f_fwd(x):
+        return x, x
+
+    def f_bwd(x, g):
+        if normalization == "batch":
+            norm = jnp.asarray(float(x.shape[0]), x.dtype)
+        elif normalization == "valid":
+            norm = jnp.maximum(
+                jnp.sum((x > valid_thresh).astype(x.dtype)), 1.0)
+        else:
+            norm = jnp.asarray(1.0, x.dtype)
+        return (jnp.full(x.shape, grad_scale, x.dtype) / norm,)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(ins[0])
+
+
 _unary("BlockGrad", jax.lax.stop_gradient, aliases=["stop_gradient"])
 _unary("identity", lambda x: x, aliases=["_copy"])
 

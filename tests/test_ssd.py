@@ -1,0 +1,156 @@
+"""SSD detection stack end-to-end: ImageDetIter + SSD symbol fwd/bwd.
+
+Reference analog: example/ssd training path (symbol_builder.get_symbol_train
+driven by the det-record iterator, ``iter_image_det_recordio.cc``).
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+cv2 = pytest.importorskip("cv2")
+
+# a small 3-scale SSD config so CPU tests stay fast (full ssd300 compiles
+# minutes of VGG16 convs; the wiring under test is identical)
+SMALL_CFG = dict(
+    from_layers=["relu4_3", "relu7", ""],
+    num_filters=[512, -1, 256],
+    strides=[-1, -1, 2],
+    pads=[-1, -1, 1],
+    sizes=[[0.2, 0.272], [0.45, 0.55], [0.8, 0.9]],
+    ratios=[[1, 2, 0.5]] * 3,
+    normalizations=[20, -1, -1],
+    steps=[],
+)
+
+
+def _det_label(objs):
+    """[header_width=2, object_width=5, (id, x1, y1, x2, y2)*N]"""
+    out = [2, 5]
+    for o in objs:
+        out.extend(o)
+    return np.array(out, np.float32)
+
+
+@pytest.fixture(scope="module")
+def det_dataset(tmp_path_factory):
+    root = tmp_path_factory.mktemp("det_imgs")
+    rng = np.random.RandomState(0)
+    imglist = []
+    for i in range(8):
+        img = rng.randint(0, 255, (50, 60, 3)).astype(np.uint8)
+        name = "img_%d.jpg" % i
+        cv2.imwrite(str(root / name), img)
+        n_obj = rng.randint(1, 4)
+        objs = []
+        for _ in range(n_obj):
+            x1, y1 = rng.uniform(0, 0.5, 2)
+            w, h = rng.uniform(0.2, 0.4, 2)
+            objs.append([float(rng.randint(0, 3)), x1, y1,
+                         min(x1 + w, 1.0), min(y1 + h, 1.0)])
+        imglist.append([_det_label(objs), name])
+    return str(root), imglist
+
+
+def test_image_det_iter(det_dataset):
+    root, imglist = det_dataset
+    it = mx.image.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                               imglist=imglist, path_root=root)
+    # label shape estimated as (max_objects, 5)
+    assert it.label_shape[1] == 5
+    max_obj = max((len(l[0]) - 2) // 5 for l in imglist)
+    assert it.label_shape[0] == max_obj
+    batch = it.next()
+    data = batch.data[0].asnumpy()
+    label = batch.label[0].asnumpy()
+    assert data.shape == (4, 3, 32, 32)
+    assert label.shape == (4, max_obj, 5)
+    # padded slots are -1, real slots have valid boxes
+    for b in range(4):
+        rows = label[b]
+        valid = rows[:, 0] >= 0
+        assert valid.any()
+        assert (rows[~valid] == -1).all()
+        vb = rows[valid]
+        assert (vb[:, 3] > vb[:, 1]).all() and (vb[:, 4] > vb[:, 2]).all()
+
+
+def test_image_det_iter_augment(det_dataset):
+    root, imglist = det_dataset
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                               imglist=imglist, path_root=root,
+                               rand_crop=0.5, rand_pad=0.5,
+                               rand_mirror=True, mean=True, std=True)
+    batch = it.next()
+    label = batch.label[0].asnumpy()
+    valid = label[label[:, :, 0] >= 0]
+    assert (valid[:, 1:5] >= -1e-5).all() and (valid[:, 1:5] <= 1 + 1e-5).all()
+
+
+def test_det_augmenter_flip():
+    aug = mx.image.DetHorizontalFlipAug(p=1.0)
+    src = np.arange(2 * 3 * 3).reshape(2, 3, 3).astype(np.uint8)
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    out, lab = aug(src, label)
+    np.testing.assert_array_equal(out, src[:, ::-1])
+    np.testing.assert_allclose(lab[0], [0, 0.6, 0.2, 0.9, 0.6], rtol=1e-6)
+
+
+def test_det_random_crop_updates_labels():
+    rng = np.random.RandomState(0)
+    aug = mx.image.DetRandomCropAug(min_object_covered=0.1,
+                                    area_range=(0.5, 1.0), max_attempts=20)
+    src = rng.randint(0, 255, (40, 40, 3)).astype(np.uint8)
+    label = np.array([[1, 0.3, 0.3, 0.7, 0.7]], np.float32)
+    out, lab = aug(src, label)
+    assert lab.shape[1] == 5
+    assert (lab[:, 1:5] >= 0).all() and (lab[:, 1:5] <= 1).all()
+
+
+def test_sync_label_shape(det_dataset):
+    root, imglist = det_dataset
+    it1 = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                                imglist=imglist[:4], path_root=root)
+    it2 = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                                imglist=imglist[4:], path_root=root)
+    it2 = it1.sync_label_shape(it2)
+    assert it1.label_shape == it2.label_shape
+
+
+@pytest.mark.slow
+def test_ssd_train_forward_backward(det_dataset):
+    """Small-config SSD: Module-free bind, one fwd/bwd, finite grads."""
+    root, imglist = det_dataset
+    net = mx.models.ssd_train(num_classes=3, **SMALL_CFG)
+    batch, hw = 2, 64
+    it = mx.image.ImageDetIter(batch_size=batch, data_shape=(3, hw, hw),
+                               imglist=imglist, path_root=root)
+    label_shape = (batch,) + it.label_shape
+    ex = net.simple_bind(mx.cpu(), data=(batch, 3, hw, hw),
+                         label=label_shape, grad_req="write")
+    # init params
+    init = mx.initializer.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name in ("data", "label"):
+            continue
+        init(mx.initializer.InitDesc(name), arr)
+    b = it.next()
+    ex.arg_dict["data"][:] = b.data[0]
+    ex.arg_dict["label"][:] = b.label[0]
+    ex.forward(is_train=True)
+    ex.backward()
+    outs = [o.asnumpy() for o in ex.outputs]
+    # cls_prob (B, C+1, N), loc_loss, cls_label, det (B, N, 6)
+    assert outs[0].shape[1] == 4
+    assert outs[3].shape[2] == 6
+    for o in outs:
+        assert np.isfinite(o).all()
+    g = ex.grad_dict["conv1_1_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_ssd_deploy_symbol_shapes():
+    net = mx.models.ssd_deploy(num_classes=3, **SMALL_CFG)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 64, 64))
+    assert len(out_shapes) == 1
+    assert out_shapes[0][2] == 6
