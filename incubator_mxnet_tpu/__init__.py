@@ -18,6 +18,41 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# Collective-worker rendezvous must run BEFORE anything touches the XLA
+# backend (jax.distributed.initialize contract).  A process spawned by
+# ``tools/launch.py`` without PS servers joins the jax.distributed cluster
+# here, at import — mirroring the reference where ps-lite's Postoffice
+# rendezvouses during library init (SURVEY.md §3.5).
+def _maybe_init_distributed():
+    import os
+
+    if os.environ.get("DMLC_ROLE", "worker") != "worker":
+        return
+    if int(os.environ.get("DMLC_NUM_SERVER", "0")) > 0:
+        return  # PS transport owns rendezvous; jax stays single-process
+    coord = os.environ.get("KVSTORE_COORDINATOR")
+    n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if not coord or n <= 1:
+        return
+    import jax
+
+    rank = int(os.environ.get("DMLC_WORKER_ID",
+                              os.environ.get("TP_PROCESS_ID", "0")))
+    port = os.environ.get("JAX_COORD_PORT", "9876")
+    try:
+        jax.distributed.initialize(
+            coordinator_address="%s:%s" % (coord, port),
+            num_processes=n, process_id=rank)
+    except RuntimeError:
+        # backend already up (user imported jax and computed first) or
+        # double-init; DistKVStore._init_distributed retries with a clear
+        # error path
+        pass
+
+
+_maybe_init_distributed()
+del _maybe_init_distributed
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, tpu, gpu, cpu_pinned, current_context, \
@@ -68,3 +103,13 @@ if "optimizer" in globals():
     Optimizer = optimizer.Optimizer  # noqa: F821
 
 waitall = nd.waitall
+
+# Server-role bootstrap: a process launched with DMLC_ROLE=server or
+# =scheduler parks in the serving loop at import and exits when the job
+# finishes — the reference's ``_init_kvstore_server_module`` contract
+# (python/mxnet/kvstore_server.py:80-85).
+if __import__("os").environ.get("DMLC_ROLE") in ("server", "scheduler"):
+    from . import kvstore_server as _kvstore_server
+
+    if _kvstore_server.init_server_module():
+        _sys.exit(0)

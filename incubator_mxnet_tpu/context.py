@@ -84,9 +84,9 @@ class Context:
             accel = _accelerator_devices()
             if accel:
                 return accel[self.device_id % len(accel)]
-            host = jax.devices("cpu")
+            host = _local_cpu_devices()
             return host[self.device_id % len(host)]
-        host = jax.devices("cpu") if _has_cpu() else jax.devices()
+        host = _local_cpu_devices() or jax.local_devices()
         return host[self.device_id % len(host)]
 
     def empty_cache(self):
@@ -99,19 +99,24 @@ class Context:
 
 
 def _accelerator_devices():
+    # local (addressable) devices only: in a multi-process job each rank
+    # must place data on its own devices, never a peer's
     import jax
 
-    devs = jax.devices()
-    return [d for d in devs if d.platform != "cpu"]
+    return [d for d in jax.local_devices() if d.platform != "cpu"]
 
 
-def _has_cpu() -> bool:
+def _local_cpu_devices():
     import jax
 
     try:
-        return bool(jax.devices("cpu"))
+        return [d for d in jax.local_devices() if d.platform == "cpu"]
     except RuntimeError:
-        return False
+        return []
+
+
+def _has_cpu() -> bool:
+    return bool(_local_cpu_devices())
 
 
 def cpu(device_id: int = 0) -> Context:

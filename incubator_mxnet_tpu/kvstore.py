@@ -169,21 +169,53 @@ class KVStore:
 
 
 class DistKVStore(KVStore):
-    """Multi-host kvstore over jax.distributed (``dist_sync`` /
-    ``dist_async`` / ``dist_device_sync``).
+    """Multi-host kvstore (``dist_sync`` / ``dist_async`` /
+    ``dist_device_sync``).
 
-    Worker-side semantics mirror ``KVStoreDist`` (kvstore_dist.h): push
-    all-reduces the gradient across processes (sum), every process runs the
-    identical updater on the identical summed gradient — numerically the
-    reference's server-side single update replicated, which the nightly
-    ``dist_sync_kvstore.py`` contract (value == rate·nrepeat·nworker+1)
-    validates.
+    Two transports (SURVEY.md §5.8 redesign):
+
+    - **sync** types ride jax.distributed XLA collectives: push psums the
+      gradient across processes over DCN in one jitted ``shard_map``
+      collective, and every worker runs the identical updater on the
+      identical summed gradient — numerically the reference's server-side
+      single update replicated, which the nightly ``dist_sync_kvstore.py``
+      contract (value == rate·nrepeat·nworker+1) validates.
+    - **``dist_async``** keeps the reference's true async semantics
+      (``kvstore_dist_server.h:154`` async branch: server applies each
+      worker's gradient immediately, no merge): when server processes are
+      launched (``tools/launch.py -s N``), pushes stream to the TCP
+      parameter server (``ps.py``), whose updater races across workers by
+      design.  Without servers it degrades to the sync collective path.
     """
 
     def __init__(self, kv_type: str):
         super().__init__(kv_type)
-        self._init_distributed()
+        self._ps_client = None
+        self._psum_allreduce_cache: Dict[tuple, Callable] = {}
+        env_servers = int(os.environ.get("DMLC_NUM_SERVER", "0"))
+        if env_servers > 0:
+            # server processes were launched: the PS transport carries this
+            # store — sync types merge-at-server, dist_async applies per
+            # push (kvstore.cc:34-57 role split)
+            self._init_ps()
+        else:
+            self._init_distributed()
 
+    # --------------------------------------------------------- ps transport
+    def _init_ps(self):
+        from . import ps
+
+        rank = int(os.environ.get("DMLC_WORKER_ID",
+                                  os.environ.get("TP_PROCESS_ID", "0")))
+        self._rank = rank
+        self._size = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._ps_client = ps.PSClient(rank)
+        if self._rank == 0:
+            # rank 0 toggles server sync mode at create (kvstore.cc:47-50)
+            self._ps_client.set_sync(self.type != "dist_async")
+        self._ps_client.barrier("create")
+
+    # -------------------------------------------------- collective transport
     def _init_distributed(self):
         import jax
 
@@ -198,8 +230,9 @@ class DistKVStore(KVStore):
             # explicit rendezvous (tools/launch.py analog): env gives
             # coordinator address + process rank/count
             n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-            r = int(os.environ.get("TP_PROCESS_ID", "0"))
-            port = os.environ.get("DMLC_PS_ROOT_PORT", "9876")
+            r = int(os.environ.get("DMLC_WORKER_ID",
+                                   os.environ.get("TP_PROCESS_ID", "0")))
+            port = os.environ.get("JAX_COORD_PORT", "9876")
             if n > 1:
                 jax.distributed.initialize(
                     coordinator_address="%s:%s" % (coord, port),
@@ -216,15 +249,47 @@ class DistKVStore(KVStore):
         return self._size
 
     def _allreduce(self, arr: NDArray) -> NDArray:
+        """One-collective psum across processes (DCN all-reduce).
+
+        Builds a (P, *shape) global array over a 1-d process mesh — one
+        device per process — and reduces with a jitted shard_map psum,
+        replacing the old allgather + host-side sum (O(P) traffic and a
+        host round-trip where one collective belongs).
+        """
         if self._size == 1:
             return arr
         import jax
-        import jax.numpy as jnp
-        from jax.experimental.multihost_utils import (
-            process_allgather)
 
-        summed = process_allgather(arr.data).sum(axis=0)
-        return NDArray(jnp.asarray(summed), ctx=arr._ctx)
+        data = arr.data
+        sig = (tuple(data.shape), str(data.dtype))
+        fn = self._psum_allreduce_cache.get(sig)
+        if fn is None:
+            fn = _build_process_psum(data.shape, data.dtype)
+            self._psum_allreduce_cache[sig] = fn
+        return NDArray(fn(data), ctx=arr._ctx)
+
+    def init(self, key, value) -> None:
+        if self._ps_client is None:
+            super().init(key, value)
+            if self._size > 1:
+                # broadcast rank 0's initial value so every worker starts
+                # from identical weights (the reference's server holds the
+                # rank-0 init: kvstore_dist.h init + first pull); psum of
+                # (rank==0 ? v : 0) is a broadcast in one collective
+                keys, _ = _key_value(key, value)
+                for k in keys:
+                    v = self._store[k]
+                    contrib = v if self._rank == 0 else \
+                        NDArray(v.data * 0, ctx=v._ctx)
+                    self._store[k]._set_data(self._allreduce(contrib).data)
+            return
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            v0 = v[0] if isinstance(v, list) else v
+            self._store[k] = v0.copy()
+            if self._rank == 0:
+                self._ps_client.init(k, v0.asnumpy())
+        self.barrier()
 
     def push(self, key, value, priority: int = 0) -> None:
         keys, values = _key_value(key, value)
@@ -232,18 +297,67 @@ class DistKVStore(KVStore):
             if not isinstance(vlist, list):
                 vlist = [vlist]
             merged = self._reduce(vlist)          # intra-process devices
+            if self._ps_client is not None:
+                # async: the server applies immediately; nothing local
+                self._ps_client.push(k, merged.asnumpy())
+                continue
             merged = self._allreduce(merged)      # inter-process DCN
             if self._updater is not None:
                 self._updater(_updater_key(k), merged, self._store[k])
             else:
                 self._store[k]._set_data(merged.data)
 
+    def pull(self, key, out=None, priority: int = 0) -> None:
+        if self._ps_client is None:
+            return super().pull(key, out=out, priority=priority)
+        keys, outs = _key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if not isinstance(olist, list):
+                olist = [olist]
+            val = self._ps_client.pull(k, self._store[k].asnumpy())
+            for o in olist:
+                o._set_data(_place_like(NDArray(val), o))
+
+    def set_optimizer(self, optimizer) -> None:
+        if self._ps_client is not None:
+            # the updater runs server-side (kvstore_dist_server.h updater)
+            self._optimizer = optimizer
+            if self._rank == 0:
+                self._ps_client.set_optimizer(optimizer)
+            self.barrier()
+            return
+        super().set_optimizer(optimizer)
+
+    def get_dead_nodes(self, timeout: float = 60):
+        """Nodes whose heartbeat is stale (``ps::Postoffice::GetDeadNodes``
+        via kvstore_dist.h:177-190); empty on the collective transport,
+        where jax.distributed owns liveness."""
+        if self._ps_client is not None:
+            return self._ps_client.dead_nodes(timeout)
+        return []
+
     def barrier(self) -> None:
+        if self._ps_client is not None:
+            from .engine import waitall
+
+            waitall()
+            self._ps_client.barrier()
+            return
         super().barrier()
         if self._size > 1:
             from jax.experimental.multihost_utils import sync_global_devices
 
             sync_global_devices("kvstore_barrier")
+
+    def _barrier_before_exit(self):
+        if self._ps_client is not None:
+            self._ps_client.finalize()
+
+    def __del__(self):
+        try:
+            self._barrier_before_exit()
+        except Exception:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -287,6 +401,39 @@ def _build_psum(devices, shape, dtype):
         for shard in out.addressable_shards:
             if shard.device == devices[0]:
                 return shard.data
+        return out.addressable_shards[0].data
+
+    return fn
+
+
+def _build_process_psum(shape, dtype):
+    """Compile a cross-process all-reduce: one device per process, global
+    (P, *shape) array, shard_map psum → replicated result; returns the
+    local shard."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    procs = jax.process_count()
+    by_proc = {}
+    for d in jax.devices():
+        by_proc.setdefault(d.process_index, d)
+    devices = [by_proc[i] for i in range(procs)]
+    mesh = Mesh(_np.asarray(devices), ("proc",))
+    in_sharding = NamedSharding(mesh, P("proc"))
+    local_dev = by_proc[jax.process_index()]
+
+    @jax.jit
+    def reduce_fn(x):
+        return shard_map(lambda s: jax.lax.psum(s[0], "proc"), mesh=mesh,
+                         in_specs=P("proc"), out_specs=P())(x)
+
+    def fn(data):
+        local = jax.device_put(data.reshape((1,) + tuple(shape)), local_dev)
+        x = jax.make_array_from_single_device_arrays(
+            (procs,) + tuple(shape), in_sharding, [local])
+        out = reduce_fn(x)
         return out.addressable_shards[0].data
 
     return fn

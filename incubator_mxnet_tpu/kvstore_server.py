@@ -1,0 +1,57 @@
+"""Server-role bootstrap (``python/mxnet/kvstore_server.py``).
+
+When a process is launched with ``DMLC_ROLE=server`` (or ``scheduler``),
+importing the package parks it in the serving loop instead of running the
+training script — the reference's ``_init_kvstore_server_module`` contract
+(kvstore_server.py:28-85).
+"""
+from __future__ import annotations
+
+import os
+
+from . import ps
+
+__all__ = ["KVStoreServer", "init_server_module"]
+
+
+class KVStoreServer:
+    """Blocks the process in the server role (kvstore_server.py:30-70)."""
+
+    def __init__(self, server_id=None):
+        env = ps.node_env()
+        self.env = env
+        self.server_id = server_id if server_id is not None else \
+            int(os.environ.get("TP_SERVER_ID", "0"))
+
+    def run(self) -> None:
+        env = self.env
+        ps.bind_runtime()  # see ps.bind_runtime: no imports in handlers
+        sched_addr = (env["scheduler_host"], env["scheduler_port"])
+        server = ps.PSServer(self.server_id, env["num_workers"], sched_addr)
+        server.register()
+        server.run()
+
+
+def _run_scheduler() -> None:
+    env = ps.node_env()
+    # bind the rendezvous address itself (DMLC_PS_ROOT_URI), never
+    # 0.0.0.0: the transport unpickles peer messages, so the listener must
+    # not be reachable beyond the cluster interface
+    sched = ps.Scheduler(env["num_workers"], env["num_servers"],
+                         host=env["scheduler_host"],
+                         port=env["scheduler_port"])
+    sched.start()
+    sched._stopped.wait()
+
+
+def init_server_module() -> bool:
+    """Enter the server/scheduler loop if this process holds that role;
+    returns True if it served (the caller should exit afterwards)."""
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role == "server":
+        KVStoreServer().run()
+        return True
+    if role == "scheduler":
+        _run_scheduler()
+        return True
+    return False

@@ -1,0 +1,573 @@
+"""Parameter-server transport: scheduler, server, and worker client.
+
+Reference analog: the ps-lite submodule (scheduler/server/worker roles,
+ZeroMQ ``Van``, ``Postoffice`` rendezvous/barriers/dead-node watch) used by
+``src/kvstore/kvstore_dist.h`` / ``kvstore_dist_server.h``.
+
+TPU-native split: the *sync* data path of ``dist_sync`` rides XLA
+collectives over DCN (see ``kvstore.py``); this module provides the pieces
+collectives cannot express —
+
+- true **async** push/pull (``dist_async``: the server applies each
+  worker's gradient immediately, no cross-worker merge —
+  ``kvstore_dist_server.h:154`` async branch),
+- the **server role** that owns weights + updater,
+- **rendezvous** (scheduler), **barriers**, **heartbeats + dead-node
+  detection** (``ps::Postoffice::GetDeadNodes``, used at
+  ``kvstore_dist.h:177-190``).
+
+Transport is length-prefixed pickled messages over TCP sockets — the
+stdlib stand-in for ps-lite's ZeroMQ Van.  Big arrays are range-sharded
+across servers by the client (``kvstore_dist.h:302-330``).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .base import MXNetError, get_env
+
+__all__ = ["Scheduler", "PSServer", "PSClient", "node_env", "DEFAULT_PORT"]
+
+DEFAULT_PORT = 9091
+_HDR = struct.Struct("!I")
+
+# Bound by ``kvstore_server`` BEFORE the serve loop parks the main thread.
+# Handler threads must NOT run import statements: the server blocks inside
+# the package's own import (``__init__`` tail), so a handler-thread
+# ``from .optimizer import ...`` would deadlock on the package import lock.
+_GET_UPDATER = None
+_ND_ARRAY = None
+
+
+def bind_runtime() -> None:
+    """Resolve the framework pieces the server role needs (called from the
+    main thread while the package import lock is still reentrant there)."""
+    global _GET_UPDATER, _ND_ARRAY
+    from .optimizer import get_updater
+    from .ndarray import array
+
+    _GET_UPDATER = get_updater
+    _ND_ARRAY = array
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+class _Unpickler(pickle.Unpickler):
+    """sys.modules-first class resolution.
+
+    Server handler threads unpickle while the main thread is parked inside
+    the package's import (``init_server_module``); pickle's default
+    ``__import__`` of e.g. ``incubator_mxnet_tpu.optimizer`` would block on
+    the parent package's import lock forever.  Every class we ship is in an
+    already-initialized module, so resolve through sys.modules directly.
+    """
+
+    def find_class(self, module, name):
+        import sys as _sys_mod
+
+        mod = _sys_mod.modules.get(module)
+        if mod is not None and getattr(mod, name, None) is not None:
+            return getattr(mod, name)
+        return super().find_class(module, name)
+
+
+def _loads(payload: bytes) -> Any:
+    import io
+
+    return _Unpickler(io.BytesIO(payload)).load()
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return _loads(_recv_exact(sock, n))
+
+
+def _connect(addr: Tuple[str, int], timeout: float = 60.0,
+             connect_retry: float = 0.0) -> socket.socket:
+    """Connect with optional retry window — peers race the scheduler's
+    startup (ps-lite's Van retries connects the same way)."""
+    deadline = time.time() + connect_retry
+    while True:
+        try:
+            return socket.create_connection(addr, timeout=timeout)
+        except (ConnectionRefusedError, socket.timeout, OSError):
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.2)
+
+
+def _rpc(addr: Tuple[str, int], obj: Any, timeout: float = 60.0,
+         connect_retry: float = 0.0) -> Any:
+    """One-shot request/response (control plane: register, barrier,
+    heartbeat, stop)."""
+    with _connect(addr, timeout, connect_retry) as sock:
+        _send_msg(sock, obj)
+        return _recv_msg(sock)
+
+
+class _ConnPool:
+    """Persistent per-peer connections for the data plane (push/pull).
+
+    ps-lite's ZeroMQ Van keeps long-lived channels; fresh TCP connects per
+    key per step would churn thousands of TIME_WAIT sockets per second.
+    One socket + lock per peer; a broken socket reconnects once.
+    """
+
+    def __init__(self):
+        self._conns: Dict[Tuple[str, int],
+                          Tuple[socket.socket, threading.Lock]] = {}
+        self._lock = threading.Lock()
+
+    def rpc(self, addr: Tuple[str, int], obj: Any,
+            timeout: float = 120.0) -> Any:
+        with self._lock:
+            entry = self._conns.get(addr)
+            if entry is None:
+                entry = (_connect(addr, timeout), threading.Lock())
+                self._conns[addr] = entry
+        sock, lk = entry
+        with lk:
+            try:
+                _send_msg(sock, obj)
+                return _recv_msg(sock)
+            except (ConnectionError, OSError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                sock = _connect(addr, timeout)
+                with self._lock:
+                    self._conns[addr] = (sock, lk)
+                _send_msg(sock, obj)
+                return _recv_msg(sock)
+
+    def close(self):
+        with self._lock:
+            for sock, _ in self._conns.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+
+def node_env() -> Dict[str, str]:
+    """Read the DMLC-style rendezvous env (tools/launch.py contract)."""
+    return {
+        "role": os.environ.get("DMLC_ROLE", "worker"),
+        "scheduler_host": os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+        "scheduler_port": int(os.environ.get("DMLC_PS_ROOT_PORT",
+                                             str(DEFAULT_PORT))),
+        "num_workers": int(os.environ.get("DMLC_NUM_WORKER", "1")),
+        "num_servers": int(os.environ.get("DMLC_NUM_SERVER", "0")),
+    }
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        # serve a persistent connection: one request/reply per message
+        # until the peer closes (the Van-style long-lived channel)
+        while True:
+            try:
+                msg = _recv_msg(self.request)
+            except (ConnectionError, OSError):
+                return
+            try:
+                reply = self.server.owner._handle(msg, self)
+            except Exception as exc:  # surface server-side errors
+                reply = {"status": "error", "error": repr(exc)}
+            if reply is not _NO_REPLY:
+                try:
+                    _send_msg(self.request, reply)
+                except (ConnectionError, OSError):
+                    return
+
+
+_NO_REPLY = object()
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _Node:
+    """Shared serve-loop plumbing for scheduler and server roles."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = _TCPServer((host, port), _Handler)
+        self._srv.owner = self
+        self.host, self.port = self._srv.server_address
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def run(self) -> None:
+        """Serve until STOP (blocks — the server-role process lives here,
+        like ``KVStoreServer.run``)."""
+        self.start()
+        self._stopped.wait()
+        self._srv.shutdown()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._srv.shutdown()
+
+    def _handle(self, msg, handler):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+
+class Scheduler(_Node):
+    """Rendezvous + barriers + liveness (``ps::Postoffice`` analog).
+
+    Servers REGISTER their data addresses; workers GET_NODES (blocking
+    until all servers are up); every node HEARTBEATs; BARRIER releases when
+    ``num_workers`` hit the same barrier id; DEAD_NODES lists nodes whose
+    last heartbeat is older than a timeout (kvstore_dist.h:177-190).
+    """
+
+    def __init__(self, num_workers: int, num_servers: int,
+                 host: str = "127.0.0.1", port: int = DEFAULT_PORT):
+        super().__init__(host, port)
+        self.num_workers = num_workers
+        self.num_servers = num_servers
+        self._lock = threading.Condition()
+        self._servers: Dict[int, Tuple[str, int]] = {}
+        self._barriers: Dict[Any, int] = {}
+        self._barrier_gen: Dict[Any, int] = {}
+        self._last_seen: Dict[str, float] = {}
+        self._done = 0
+
+    def _handle(self, msg, handler):
+        cmd = msg["cmd"]
+        now = time.time()
+        if "node" in msg:
+            with self._lock:
+                self._last_seen[msg["node"]] = now
+        if cmd == "register_server":
+            with self._lock:
+                self._servers[msg["server_id"]] = tuple(msg["addr"])
+                self._lock.notify_all()
+            return {"status": "ok"}
+        if cmd == "get_nodes":
+            with self._lock:
+                while len(self._servers) < self.num_servers:
+                    if not self._lock.wait(timeout=120):
+                        return {"status": "error",
+                                "error": "rendezvous timeout"}
+                return {"status": "ok",
+                        "servers": [self._servers[i]
+                                    for i in sorted(self._servers)]}
+        if cmd == "heartbeat":
+            return {"status": "ok"}
+        if cmd == "barrier":
+            bid = msg["barrier_id"]
+            with self._lock:
+                gen = self._barrier_gen.setdefault(bid, 0)
+                self._barriers[bid] = self._barriers.get(bid, 0) + 1
+                if self._barriers[bid] >= self.num_workers:
+                    self._barriers[bid] = 0
+                    self._barrier_gen[bid] = gen + 1
+                    self._lock.notify_all()
+                else:
+                    while self._barrier_gen.get(bid, 0) == gen:
+                        if not self._lock.wait(timeout=300):
+                            return {"status": "error",
+                                    "error": "barrier timeout"}
+            return {"status": "ok"}
+        if cmd == "dead_nodes":
+            timeout = msg.get("timeout", 60)
+            with self._lock:
+                dead = [n for n, t in self._last_seen.items()
+                        if now - t > timeout]
+            return {"status": "ok", "dead": dead}
+        if cmd == "finalize":
+            # workers report completion; when all have, stop the cluster
+            with self._lock:
+                self._done += 1
+                done = self._done >= self.num_workers
+                servers = list(self._servers.values())
+            if done:
+                for addr in servers:
+                    try:
+                        _rpc(addr, {"cmd": "stop"})
+                    except OSError:
+                        pass
+                threading.Thread(target=self.stop, daemon=True).start()
+            return {"status": "ok"}
+        if cmd == "stop":
+            threading.Thread(target=self.stop, daemon=True).start()
+            return {"status": "ok"}
+        return {"status": "error", "error": "unknown cmd %s" % cmd}
+
+
+# ---------------------------------------------------------------------------
+# server role
+# ---------------------------------------------------------------------------
+
+
+class PSServer(_Node):
+    """Holds weight shards + runs the updater (``KVStoreDistServer``).
+
+    - sync mode: pushes accumulate into a merge buffer; when
+      ``num_workers`` pushes arrived for a key, the updater runs ONCE and
+      pending pulls release (kvstore_dist_server.h:182+);
+    - async mode: each push updates immediately (``DataHandle`` async
+      branch) — workers racing is the *intended* semantics.
+    """
+
+    def __init__(self, server_id: int, num_workers: int,
+                 scheduler: Tuple[str, int], host: str = "127.0.0.1"):
+        super().__init__(host, 0)
+        self.server_id = server_id
+        self.num_workers = num_workers
+        self.scheduler = scheduler
+        self.sync_mode = False
+        self._store: Dict[Any, np.ndarray] = {}
+        self._merge: Dict[Any, Tuple[np.ndarray, int]] = {}
+        self._updater: Optional[Callable] = None
+        self._lock = threading.Condition()
+
+    def register(self) -> None:
+        _rpc(self.scheduler, {"cmd": "register_server",
+                              "server_id": self.server_id,
+                              "addr": (self.host, self.port),
+                              "node": "server%d" % self.server_id},
+             connect_retry=60.0)
+        # keep our liveness fresh at the scheduler; without this the
+        # GetDeadNodes analog would flag healthy servers once a job
+        # outlives the staleness timeout
+        self._hb_stop = threading.Event()
+        threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+
+    def _heartbeat_loop(self):
+        node = "server%d" % self.server_id
+        while not self._hb_stop.wait(5.0):
+            if self._stopped.is_set():
+                return
+            try:
+                _rpc(self.scheduler, {"cmd": "heartbeat", "node": node},
+                     timeout=10.0)
+            except OSError:
+                pass
+
+    def _apply(self, key, grad):
+        if self._updater is not None:
+            # the updater speaks NDArray (optimizer.Updater); the server
+            # store is host numpy — wrap, update, write back
+            weight = _ND_ARRAY(self._store[key])
+            self._updater(key, _ND_ARRAY(grad), weight)
+            self._store[key] = weight.asnumpy()
+        else:
+            self._store[key] = np.array(grad)
+
+    def _handle(self, msg, handler):
+        cmd = msg["cmd"]
+        if cmd == "init":
+            with self._lock:
+                self._store[msg["key"]] = np.array(msg["value"],
+                                                   dtype=np.float32)
+            return {"status": "ok"}
+        if cmd == "push":
+            key, grad = msg["key"], msg["value"]
+            with self._lock:
+                if not self.sync_mode:
+                    self._apply(key, grad)
+                else:
+                    buf, cnt = self._merge.get(key, (None, 0))
+                    buf = grad.copy() if buf is None else buf + grad
+                    cnt += 1
+                    if cnt >= self.num_workers:
+                        self._apply(key, buf)
+                        self._merge[key] = (None, 0)
+                        self._lock.notify_all()
+                    else:
+                        self._merge[key] = (buf, cnt)
+            return {"status": "ok"}
+        if cmd == "pull":
+            key = msg["key"]
+            with self._lock:
+                if self.sync_mode:
+                    # release only after the round's merge completed
+                    while self._merge.get(key, (None, 0))[1] > 0:
+                        if not self._lock.wait(timeout=300):
+                            return {"status": "error",
+                                    "error": "sync pull timeout"}
+                if key not in self._store:
+                    return {"status": "error",
+                            "error": "key %r not initialized" % (key,)}
+                return {"status": "ok", "value": self._store[key]}
+        if cmd == "set_updater":
+            # optimizer shipped as pickled bytes (reference sends the
+            # optimizer to servers via a command, kvstore.py:set_optimizer)
+            opt = _loads(msg["optimizer"])
+            self._updater = _GET_UPDATER(opt)
+            return {"status": "ok"}
+        if cmd == "set_sync":
+            self.sync_mode = bool(msg["sync"])
+            return {"status": "ok"}
+        if cmd == "stop":
+            threading.Thread(target=self.stop, daemon=True).start()
+            return {"status": "ok"}
+        return {"status": "error", "error": "unknown cmd %s" % cmd}
+
+
+# ---------------------------------------------------------------------------
+# worker client
+# ---------------------------------------------------------------------------
+
+
+class PSClient:
+    """Worker-side connection to the PS cluster (``ps::KVWorker``).
+
+    Key placement: whole arrays go to ``hash(key) % num_servers``; arrays
+    with more rows than ``bigarray_bound`` are range-sharded across ALL
+    servers (kvstore_dist.h:302-330) so no single server owns a huge key.
+    """
+
+    def __init__(self, rank: int,
+                 scheduler: Optional[Tuple[str, int]] = None,
+                 bigarray_bound: Optional[int] = None):
+        env = node_env()
+        self.rank = rank
+        self.node = "worker%d" % rank
+        self.scheduler = scheduler or (env["scheduler_host"],
+                                       env["scheduler_port"])
+        self.bigarray_bound = bigarray_bound if bigarray_bound is not None \
+            else int(get_env("KVSTORE_BIGARRAY_BOUND", 1 << 19))
+        reply = _rpc(self.scheduler, {"cmd": "get_nodes",
+                                      "node": self.node},
+                     timeout=180.0, connect_retry=60.0)
+        if reply["status"] != "ok":
+            raise MXNetError("rendezvous failed: %s" % reply.get("error"))
+        self.servers: List[Tuple[str, int]] = [tuple(a)
+                                               for a in reply["servers"]]
+        if not self.servers:
+            raise MXNetError("no servers registered")
+        self._pool = _ConnPool()
+        self._hb = threading.Thread(target=self._heartbeat_loop,
+                                    daemon=True)
+        self._hb_stop = threading.Event()
+        self._hb.start()
+
+    # -------------------------------------------------------------- liveness
+    def _heartbeat_loop(self):
+        while not self._hb_stop.wait(5.0):
+            try:
+                _rpc(self.scheduler, {"cmd": "heartbeat",
+                                      "node": self.node})
+            except OSError:
+                pass
+
+    def dead_nodes(self, timeout: float = 60) -> List[str]:
+        reply = _rpc(self.scheduler, {"cmd": "dead_nodes",
+                                      "timeout": timeout,
+                                      "node": self.node})
+        return reply.get("dead", [])
+
+    # ------------------------------------------------------------- placement
+    def _plan(self, key, arr: np.ndarray):
+        """-> list of (server_idx, subkey, row_slice)"""
+        n = len(self.servers)
+        if arr.size >= self.bigarray_bound and n > 1 and arr.shape[0] >= n:
+            rows = arr.shape[0]
+            step = (rows + n - 1) // n
+            plan = []
+            for i in range(n):
+                lo = i * step
+                hi = min(rows, lo + step)
+                if lo >= hi:
+                    break
+                plan.append((i, ("%s#%d" % (key, i)), slice(lo, hi)))
+            return plan
+        # process-stable placement (str hash is randomized per process)
+        import zlib
+
+        return [(zlib.crc32(str(key).encode()) % n, key, slice(None))]
+
+    # ------------------------------------------------------------------- api
+    def init(self, key, value: np.ndarray) -> None:
+        for sidx, subkey, sl in self._plan(key, value):
+            self._pool.rpc(self.servers[sidx],
+                           {"cmd": "init", "key": subkey,
+                            "value": value[sl]})
+
+    def push(self, key, value: np.ndarray) -> None:
+        for sidx, subkey, sl in self._plan(key, value):
+            reply = self._pool.rpc(self.servers[sidx],
+                                   {"cmd": "push", "key": subkey,
+                                    "value":
+                                    np.ascontiguousarray(value[sl])})
+            if reply["status"] != "ok":
+                raise MXNetError("push failed: %s" % reply.get("error"))
+
+    def pull(self, key, like: np.ndarray) -> np.ndarray:
+        out = np.empty_like(like)
+        for sidx, subkey, sl in self._plan(key, like):
+            reply = self._pool.rpc(self.servers[sidx],
+                                   {"cmd": "pull", "key": subkey})
+            if reply["status"] != "ok":
+                raise MXNetError("pull failed: %s" % reply.get("error"))
+            out[sl] = reply["value"]
+        return out
+
+    def set_optimizer(self, optimizer) -> None:
+        blob = pickle.dumps(optimizer)
+        for addr in self.servers:
+            _rpc(addr, {"cmd": "set_updater", "optimizer": blob})
+
+    def set_sync(self, sync: bool) -> None:
+        for addr in self.servers:
+            _rpc(addr, {"cmd": "set_sync", "sync": sync})
+
+    def barrier(self, barrier_id="default") -> None:
+        reply = _rpc(self.scheduler, {"cmd": "barrier",
+                                      "barrier_id": barrier_id,
+                                      "node": self.node}, timeout=600)
+        if reply["status"] != "ok":
+            raise MXNetError("barrier failed: %s" % reply.get("error"))
+
+    def finalize(self) -> None:
+        """Barrier-before-exit + cluster shutdown vote
+        (``kvstore.h:241`` barrier_before_exit)."""
+        self._hb_stop.set()
+        try:
+            _rpc(self.scheduler, {"cmd": "finalize", "node": self.node})
+        except OSError:
+            pass

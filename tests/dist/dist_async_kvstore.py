@@ -1,0 +1,54 @@
+"""dist_async worker script — run under ``tools/launch.py -n 2 -s 2``.
+
+Async contract (``kvstore_dist_server.h:154`` async branch): the server
+applies every worker's push immediately — no cross-worker merge — so after
+all workers push ``NREPEAT`` ones through the ``test`` updater
+(w += rate·g) and then barrier, the pulled value is exactly
+``init + rate·NREPEAT·nworker`` even though the per-push interleaving is
+racy.  Includes a big range-sharded key (kvstore_dist.h:302-330).
+"""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+
+SHAPES = {"w": (8, 8), "big": (2048, 64)}  # big: 131072 rows*cols > bound
+RATE = 2
+NREPEAT = 4
+
+
+def main():
+    os.environ.setdefault("KVSTORE_BIGARRAY_BOUND", str(1 << 16))
+    kv = mx.kv.create("dist_async")
+    nworker = kv.num_workers
+    kv.set_optimizer(mx.optimizer.create("test", rescale_grad=RATE))
+    for k, s in SHAPES.items():
+        kv.init(k, mx.nd.ones(s))
+    for _ in range(NREPEAT):
+        for k, s in SHAPES.items():
+            kv.push(k, mx.nd.ones(s))
+    kv.barrier()
+    for k, s in SHAPES.items():
+        out = mx.nd.zeros(s)
+        kv.pull(k, out=out)
+        expected = 1 + RATE * NREPEAT * nworker
+        got = out.asnumpy()
+        assert (got == expected).all(), \
+            "key %s: got %s expected %s" % (k, np.unique(got), expected)
+    dead = kv.get_dead_nodes(timeout=600)
+    assert dead == [], dead
+    kv._barrier_before_exit()
+    print("dist_async_kvstore rank %d/%d: OK" % (kv.rank, nworker),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Exact-value dist_sync worker script — run under ``tools/launch.py -n 4``.
+
+Port of ``/root/reference/tests/nightly/dist_sync_kvstore.py:36-55``: with
+the ``test`` optimizer (w += rescale·grad), after each worker pushes ones
+``nrepeat`` times, every key must be exactly
+``init + rate·nrepeat·nworker`` — integer-exact, so any dropped or
+double-counted message fails the assert.  Includes a key larger than the
+big-array bound.
+"""
+import os
+import sys
+
+# worker processes must pin the CPU platform before jax initializes
+# (conftest does this for in-process tests; launched processes need it here)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+
+SHAPES = {"3": (4, 4), "99": (700, 100)}  # 70000 > default big bound/8
+RATE = 2
+NREPEAT = 3
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    nworker = kv.num_workers
+    assert nworker == int(os.environ["DMLC_NUM_WORKER"]), \
+        (nworker, os.environ["DMLC_NUM_WORKER"])
+    kv.set_optimizer(mx.optimizer.create("test", rescale_grad=RATE))
+    for k, s in SHAPES.items():
+        kv.init(k, mx.nd.ones(s))
+    kv.barrier()
+    for _ in range(NREPEAT):
+        for k, s in SHAPES.items():
+            kv.push(k, mx.nd.ones(s))
+    kv.barrier()
+    for k, s in SHAPES.items():
+        out = mx.nd.zeros(s)
+        kv.pull(k, out=out)
+        expected = 1 + RATE * NREPEAT * nworker
+        got = out.asnumpy()
+        assert (got == expected).all(), \
+            "key %s: got %s expected %s" % (k, np.unique(got), expected)
+    print("dist_sync_kvstore rank %d/%d: OK" % (kv.rank, nworker),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Multi-process distributed kvstore proof on localhost.
+
+Reference analog: ``tests/nightly/test_all.sh:55`` running
+``tools/launch.py -n 4 python dist_sync_kvstore.py`` — distribution
+validated without a cluster via local processes with exact-value asserts.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+
+
+def _run_launch(args, script, timeout=600):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # worker scripts pin cpu themselves
+    cmd = [sys.executable, LAUNCH] + args + [sys.executable, script]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=REPO)
+    if proc.returncode != 0:
+        raise AssertionError(
+            "launch failed rc=%d\nstdout:\n%s\nstderr:\n%s"
+            % (proc.returncode, proc.stdout[-4000:], proc.stderr[-4000:]))
+    return proc
+
+
+@pytest.mark.slow
+def test_dist_sync_kvstore_4_workers():
+    proc = _run_launch(["-n", "4"],
+                       os.path.join(REPO, "tests", "dist",
+                                    "dist_sync_kvstore.py"))
+    assert proc.stdout.count("OK") == 4, proc.stdout
+
+
+@pytest.mark.slow
+def test_dist_sync_kvstore_via_parameter_server():
+    """Same exact-value contract, but carried by the PS transport in
+    server-merge sync mode (kvstore_dist_server.h:182 merge-then-update)."""
+    proc = _run_launch(["-n", "2", "-s", "2"],
+                       os.path.join(REPO, "tests", "dist",
+                                    "dist_sync_kvstore.py"))
+    assert proc.stdout.count("OK") == 2, proc.stdout
+
+
+@pytest.mark.slow
+def test_dist_async_kvstore_2x2():
+    proc = _run_launch(["-n", "2", "-s", "2"],
+                       os.path.join(REPO, "tests", "dist",
+                                    "dist_async_kvstore.py"))
+    assert proc.stdout.count("OK") == 2, proc.stdout

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Local cluster launcher (``/root/reference/tools/launch.py:29-79`` via
+dmlc-tracker's local launcher).
+
+Spawns scheduler + server + worker processes on this machine with env-var
+rendezvous:
+
+- PS roles (``-s N``): ``DMLC_ROLE`` ∈ {scheduler, server, worker};
+  importing the framework in a server/scheduler process parks it in the
+  serving loop (``kvstore_server.init_server_module``);
+- collective workers additionally get a jax.distributed coordinator
+  (worker 0) so ``dist_sync`` kvstores psum over DCN.
+
+Example (the nightly contract, ``tests/nightly/test_all.sh:55``)::
+
+    python tools/launch.py -n 4 python dist_sync_kvstore.py
+    python tools/launch.py -n 4 -s 2 python async_training.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Launch a distributed job locally")
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="number of parameter-server processes "
+                         "(0 = collective-only transport)")
+    ap.add_argument("--launcher", default="local",
+                    choices=["local"],
+                    help="only the local launcher is provided; cluster "
+                         "schedulers (k8s/slurm) own multi-host spawns "
+                         "for TPU pods")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE env for all nodes")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="training command to run on each worker")
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    base_env = dict(os.environ)
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        base_env[k] = v
+    base_env["DMLC_NUM_WORKER"] = str(args.num_workers)
+    base_env["DMLC_NUM_SERVER"] = str(args.num_servers)
+    base_env["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+    base_env["DMLC_PS_ROOT_PORT"] = str(_free_port())
+    base_env["KVSTORE_COORDINATOR"] = "127.0.0.1"
+    base_env["JAX_COORD_PORT"] = str(_free_port())
+
+    procs = []
+
+    def spawn(role, extra):
+        env = dict(base_env)
+        env["DMLC_ROLE"] = role
+        env.update(extra)
+        p = subprocess.Popen(args.command, env=env)
+        procs.append((role, p))
+        return p
+
+    try:
+        if args.num_servers > 0:
+            spawn("scheduler", {})
+            for i in range(args.num_servers):
+                spawn("server", {"TP_SERVER_ID": str(i)})
+        workers = []
+        for r in range(args.num_workers):
+            workers.append(spawn("worker", {"DMLC_WORKER_ID": str(r)}))
+        rc = 0
+        for w in workers:
+            code = w.wait()
+            if code != 0:
+                # signal deaths return negative codes; normalize to the
+                # shell convention so a crashed worker can't read as rc=0
+                rc = max(rc, code if code > 0 else 128 + abs(code))
+        return rc
+    finally:
+        for role, p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        for role, p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
