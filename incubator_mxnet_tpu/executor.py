@@ -78,7 +78,8 @@ class Executor:
         """Build the pure jax function over (args, aux, key) once."""
         from .lowering import lower_symbol
 
-        return lower_symbol(self._symbol, is_train)
+        return lower_symbol(self._symbol, is_train,
+                            group2ctx=self._group2ctx)
 
     def _get_fwd(self, is_train: bool):
         if is_train not in self._fwd_jit:

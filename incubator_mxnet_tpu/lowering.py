@@ -8,7 +8,7 @@ from .ops.registry import OpContext
 __all__ = ["lower_symbol"]
 
 
-def lower_symbol(symbol, is_train: bool):
+def lower_symbol(symbol, is_train: bool, group2ctx=None):
     """Lower a Symbol DAG to ``fn(arg_vals, aux_vals, key) ->
     (outputs, new_aux)``.
 
@@ -16,26 +16,50 @@ def lower_symbol(symbol, is_train: bool):
     the node DAG over the op registry, with per-node PRNG keys derived by
     ``fold_in`` and functional aux-state threading (the reference mutated
     aux NDArrays in place; here the executor rebinds them).
+
+    ``group2ctx`` maps ``ctx_group`` attr values (attached via
+    ``mx.AttrScope(ctx_group=...)``) to Contexts — the group2ctx
+    model-parallel mechanism (``graph_executor.cc:279-393`` AssignContext:
+    PlaceDevice pass + ``_CrossDeviceCopy`` insertion;
+    ``example/model-parallel-lstm/lstm.py:65-68``).  TPU-native form: each
+    grouped node's outputs are committed to its group's device *inside*
+    the jitted program, so XLA itself plans the graph partition and
+    inserts the cross-device transfers — one compiled program spanning the
+    devices rather than copy nodes between per-device executors.
     """
+    import jax
+
     nodes = symbol.topo_nodes()
     outputs = symbol._outputs
     aux_names = set(symbol.list_auxiliary_states())
 
-    def fn(arg_vals, aux_vals, key):
-        import jax
+    node_device = {}
+    if group2ctx:
+        devmap = {g: ctx.jax_device for g, ctx in group2ctx.items()}
+        for node in nodes:
+            grp = (node.attrs or {}).get("ctx_group")
+            if grp is not None and str(grp) in devmap:
+                node_device[id(node)] = devmap[str(grp)]
 
+    def fn(arg_vals, aux_vals, key):
         env = {}
         new_aux = dict(aux_vals)
         for ni, node in enumerate(nodes):
             if node.is_variable:
-                env[(id(node), 0)] = (new_aux[node.name]
-                                      if node.name in aux_names
-                                      else arg_vals[node.name])
+                val = (new_aux[node.name] if node.name in aux_names
+                       else arg_vals[node.name])
+                dev = node_device.get(id(node))
+                if dev is not None:
+                    val = jax.device_put(val, dev)
+                env[(id(node), 0)] = val
                 continue
             ins = [env[(id(inp), idx)] for inp, idx in node.inputs]
             rng = jax.random.fold_in(key, ni) if node.op.needs_rng else None
             outs, naux = node.op.apply(
                 ins, node.attrs, OpContext(is_train=is_train, rng=rng))
+            dev = node_device.get(id(node))
+            if dev is not None:
+                outs = [jax.device_put(o, dev) for o in outs]
             for i, o in enumerate(outs):
                 env[(id(node), i)] = o
             if node.op.has_aux:

@@ -1,0 +1,120 @@
+"""group2ctx model parallelism on the symbolic path.
+
+Reference analog: ``tests/python/unittest/test_model_parallel.py`` (CPU
+contexts shard the graph; no accelerator needed) and the model-parallel
+LSTM mechanism (``example/model-parallel-lstm/lstm.py:65-68``).
+"""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+
+
+def _chain_net():
+    data1 = mx.sym.Variable("data1")
+    data2 = mx.sym.Variable("data2")
+    data3 = mx.sym.Variable("data3")
+    with mx.AttrScope(ctx_group="dev1"):
+        net = data1 + data2
+        net = net * 3
+    with mx.AttrScope(ctx_group="dev2"):
+        net = net + data3
+    return net
+
+
+def test_chain_group2ctx_matches_single_device():
+    ctx1, ctx2 = mx.cpu(0), mx.cpu(1)
+    shape = (4, 5)
+    net = _chain_net()
+
+    args = {"data1": mx.nd.ones(shape, ctx=ctx1),
+            "data2": mx.nd.ones(shape, ctx=ctx1) * 2,
+            "data3": mx.nd.ones(shape, ctx=ctx2) * 3}
+    grads = {k: mx.nd.zeros(shape, ctx=v.context)
+             for k, v in args.items()}
+    ex1 = net.bind(ctx1, args=args, args_grad=grads,
+                   group2ctx={"dev1": ctx1, "dev2": ctx2})
+
+    args2 = {k: mx.nd.array(v.asnumpy(), ctx=ctx1)
+             for k, v in args.items()}
+    grads2 = {k: mx.nd.zeros(shape, ctx=ctx1) for k in args}
+    ex2 = net.bind(ctx1, args=args2, args_grad=grads2)
+
+    ex1.forward(is_train=True)
+    ex2.forward(is_train=True)
+    np.testing.assert_allclose(ex1.outputs[0].asnumpy(),
+                               ex2.outputs[0].asnumpy(), rtol=1e-6)
+    og = mx.nd.ones(shape, ctx=ctx1)
+    ex1.backward([og])
+    ex2.backward([og])
+    for k in grads:
+        np.testing.assert_allclose(grads[k].asnumpy(),
+                                   grads2[k].asnumpy(), rtol=1e-6)
+
+
+def test_group2ctx_places_outputs():
+    """Grouped nodes' outputs are actually committed to the group device
+    (PlaceDevice semantics: the compiled program spans both devices)."""
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        import pytest
+
+        pytest.skip("needs >= 2 devices")
+    ctx1, ctx2 = mx.cpu(0), mx.cpu(1)
+    net = _chain_net()
+    shape = (2, 3)
+    args = {n: mx.nd.ones(shape) for n in ("data1", "data2", "data3")}
+    ex = net.bind(ctx1, args=args,
+                  group2ctx={"dev1": ctx1, "dev2": ctx2})
+    ex.forward(is_train=False)
+    out = ex.outputs[0]
+    out_dev = next(iter(out.data.devices()))
+    assert out_dev == ctx2.jax_device, (out_dev, ctx2.jax_device)
+
+
+def test_model_parallel_lstm_style_fc_chain():
+    """Layer-wise partition of an MLP across 4 'devices' trains and
+    matches the single-device executor numerically (the model-parallel
+    LSTM pattern with FC layers standing in for LSTM cells)."""
+    rng = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    net = data
+    ngroups = 4
+    for i in range(ngroups):
+        with mx.AttrScope(ctx_group="dev%d" % i):
+            net = mx.sym.FullyConnected(net, num_hidden=16,
+                                        name="fc%d" % i)
+            net = mx.sym.Activation(net, act_type="tanh",
+                                    name="act%d" % i)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    group2ctx = {"dev%d" % i: mx.cpu(i) for i in range(ngroups)}
+    shapes = {"data": (8, 10), "softmax_label": (8,)}
+    ex_mp = net.simple_bind(mx.cpu(0), grad_req="write",
+                            group2ctx=group2ctx, **shapes)
+    ex_sp = net.simple_bind(mx.cpu(0), grad_req="write", **shapes)
+
+    init = mx.initializer.Uniform(0.1)
+    for name in ex_mp.arg_dict:
+        if name in shapes:
+            continue
+        v = mx.nd.empty(ex_mp.arg_dict[name].shape)
+        init(mx.initializer.InitDesc(name), v)
+        ex_mp.arg_dict[name][:] = v
+        ex_sp.arg_dict[name][:] = v
+    x = rng.randn(8, 10).astype(np.float32)
+    y = rng.randint(0, 16, 8).astype(np.float32)
+    for ex in (ex_mp, ex_sp):
+        ex.arg_dict["data"][:] = mx.nd.array(x)
+        ex.arg_dict["softmax_label"][:] = mx.nd.array(y)
+        ex.forward(is_train=True)
+        ex.backward()
+    np.testing.assert_allclose(ex_mp.outputs[0].asnumpy(),
+                               ex_sp.outputs[0].asnumpy(), rtol=1e-5)
+    for name in ex_mp.grad_dict:
+        if ex_mp.grad_dict[name] is None:
+            continue
+        np.testing.assert_allclose(ex_mp.grad_dict[name].asnumpy(),
+                                   ex_sp.grad_dict[name].asnumpy(),
+                                   rtol=1e-4, atol=1e-6)
