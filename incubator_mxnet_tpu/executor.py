@@ -74,18 +74,38 @@ class Executor:
         return self._outputs_cache
 
     # ---------------------------------------------------------------- lower
-    def _lowered(self, is_train: bool):
-        """Build the pure jax function over (args, aux, key) once."""
-        from .lowering import lower_symbol
+    def _is_grouped(self) -> bool:
+        """True when group2ctx actually spans a device different from the
+        bind context — then the symbol is partitioned into per-device
+        jitted segments with explicit transfers (the reference's
+        PlaceDevice + ``_CrossDeviceCopy``, ``graph_executor.cc:279-393``)
+        and the top-level driver must run eagerly (jax.jit refuses
+        arguments committed to different devices)."""
+        if not self._group2ctx:
+            return False
+        devs = {ctx.jax_device for ctx in self._group2ctx.values()}
+        devs.add(self._ctx.jax_device)
+        return len(devs) > 1
 
-        return lower_symbol(self._symbol, is_train,
-                            group2ctx=self._group2ctx)
+    def _lowered(self, is_train: bool):
+        """Build the jax function over (args, aux, key) once."""
+        from .lowering import lower_symbol, lower_symbol_grouped
+
+        if self._is_grouped():
+            return lower_symbol_grouped(self._symbol, is_train,
+                                        self._group2ctx,
+                                        self._ctx.jax_device)
+        return lower_symbol(self._symbol, is_train)
 
     def _get_fwd(self, is_train: bool):
         if is_train not in self._fwd_jit:
             import jax
 
-            self._fwd_jit[is_train] = jax.jit(self._lowered(is_train))
+            fn = self._lowered(is_train)
+            # grouped driver already jits per segment; the driver itself
+            # must stay eager (cross-device transfers inside)
+            self._fwd_jit[is_train] = fn if self._is_grouped() \
+                else jax.jit(fn)
         return self._fwd_jit[is_train]
 
     def _get_bwd(self):
@@ -116,7 +136,7 @@ class Executor:
                 (grads,) = vjp_fn((ct_outs, ct_aux))
                 return outs, new_aux, grads
 
-            self._bwd_jit = jax.jit(bwd)
+            self._bwd_jit = bwd if self._is_grouped() else jax.jit(bwd)
         return self._bwd_jit
 
     # ----------------------------------------------------------------- run
@@ -247,7 +267,8 @@ class Executor:
             grad_dict[n] = NDArray(jnp.zeros(s, dtype=g.dtype),
                                    ctx=self._ctx)
         return Executor(self._symbol, self._ctx, new_args, grad_dict,
-                        dict(self._grad_req), dict(self.aux_dict))
+                        dict(self._grad_req), dict(self.aux_dict),
+                        group2ctx=self._group2ctx)
 
     def set_monitor_callback(self, callback) -> None:
         self._monitor_callback = callback

@@ -116,13 +116,22 @@ class KVStore:
                 acc = acc + jax.device_put(v.data, dev)
             return NDArray(acc, ctx=vlist[0]._ctx)
 
+        # canonical device ordering so a different push order of the same
+        # device set reuses one compiled reducer (sum is order-invariant;
+        # the psum result is replicated on every device)
+        order = sorted(range(len(devs)),
+                       key=lambda i: (devs[i].platform, devs[i].id))
+        sdevs = [devs[i] for i in order]
         arr0 = vlist[0].data
-        sig = (tuple(arr0.shape), str(arr0.dtype), tuple(id(d) for d in devs))
+        sig = (tuple(arr0.shape), str(arr0.dtype),
+               tuple((d.platform, d.id) for d in sdevs))
         fn = self._psum_cache.get(sig)
         if fn is None:
-            fn = _build_psum(devs, arr0.shape, arr0.dtype)
+            fn = _build_psum(sdevs, arr0.shape, arr0.dtype)
             self._psum_cache[sig] = fn
-        out_shards = fn([v.data for v in vlist])
+        # result shard on the push-order-first device, preserving the
+        # invariant that the merged gradient lives on vlist[0]'s device
+        out_shards = fn([vlist[i].data for i in order], out_dev=devs[0])
         return NDArray(out_shards, ctx=vlist[0]._ctx)
 
     # ------------------------------------------------------------ optimizer
@@ -388,7 +397,7 @@ def _build_psum(devices, shape, dtype):
             lambda s: jax.lax.psum(s[0], "dev"), mesh=mesh,
             in_specs=P("dev"), out_specs=P())(x)
 
-    def fn(shards):
+    def fn(shards, out_dev=None):
         global_shape = (n,) + tuple(shape)
         arrs = [jax.device_put(s.reshape((1,) + tuple(shape)), d)
                 for s, d in zip(shards, devices)]
@@ -396,10 +405,11 @@ def _build_psum(devices, shape, dtype):
             global_shape, in_sharding, arrs)
         out = reduce_fn(x)
         # the result is replicated on every contributing device; hand back
-        # the zero-copy local shard on the first device (the "merge device"
-        # the updater then runs on, comm.h:344 round-robin analog)
+        # the zero-copy local shard on the requested "merge device" (the
+        # device the updater then runs on, comm.h:344 round-robin analog)
+        tgt = out_dev if out_dev is not None else devices[0]
         for shard in out.addressable_shards:
-            if shard.device == devices[0]:
+            if shard.device == tgt:
                 return shard.data
         return out.addressable_shards[0].data
 

@@ -5,27 +5,18 @@ from __future__ import annotations
 
 from .ops.registry import OpContext
 
-__all__ = ["lower_symbol"]
+__all__ = ["lower_symbol", "lower_symbol_grouped"]
 
 
-def lower_symbol(symbol, is_train: bool, group2ctx=None):
+def lower_symbol(symbol, is_train: bool):
     """Lower a Symbol DAG to ``fn(arg_vals, aux_vals, key) ->
     (outputs, new_aux)``.
 
-    The returned function is jax-traceable: topological interpretation of
-    the node DAG over the op registry, with per-node PRNG keys derived by
-    ``fold_in`` and functional aux-state threading (the reference mutated
-    aux NDArrays in place; here the executor rebinds them).
-
-    ``group2ctx`` maps ``ctx_group`` attr values (attached via
-    ``mx.AttrScope(ctx_group=...)``) to Contexts — the group2ctx
-    model-parallel mechanism (``graph_executor.cc:279-393`` AssignContext:
-    PlaceDevice pass + ``_CrossDeviceCopy`` insertion;
-    ``example/model-parallel-lstm/lstm.py:65-68``).  TPU-native form: each
-    grouped node's outputs are committed to its group's device *inside*
-    the jitted program, so XLA itself plans the graph partition and
-    inserts the cross-device transfers — one compiled program spanning the
-    devices rather than copy nodes between per-device executors.
+    The returned function is pure and jax-traceable: topological
+    interpretation of the node DAG over the op registry, with per-node
+    PRNG keys derived by ``fold_in`` and functional aux-state threading
+    (the reference mutated aux NDArrays in place; here the executor
+    rebinds them).
     """
     import jax
 
@@ -33,33 +24,19 @@ def lower_symbol(symbol, is_train: bool, group2ctx=None):
     outputs = symbol._outputs
     aux_names = set(symbol.list_auxiliary_states())
 
-    node_device = {}
-    if group2ctx:
-        devmap = {g: ctx.jax_device for g, ctx in group2ctx.items()}
-        for node in nodes:
-            grp = (node.attrs or {}).get("ctx_group")
-            if grp is not None and str(grp) in devmap:
-                node_device[id(node)] = devmap[str(grp)]
-
     def fn(arg_vals, aux_vals, key):
         env = {}
         new_aux = dict(aux_vals)
         for ni, node in enumerate(nodes):
             if node.is_variable:
-                val = (new_aux[node.name] if node.name in aux_names
-                       else arg_vals[node.name])
-                dev = node_device.get(id(node))
-                if dev is not None:
-                    val = jax.device_put(val, dev)
-                env[(id(node), 0)] = val
+                env[(id(node), 0)] = (new_aux[node.name]
+                                      if node.name in aux_names
+                                      else arg_vals[node.name])
                 continue
             ins = [env[(id(inp), idx)] for inp, idx in node.inputs]
             rng = jax.random.fold_in(key, ni) if node.op.needs_rng else None
             outs, naux = node.op.apply(
                 ins, node.attrs, OpContext(is_train=is_train, rng=rng))
-            dev = node_device.get(id(node))
-            if dev is not None:
-                outs = [jax.device_put(o, dev) for o in outs]
             for i, o in enumerate(outs):
                 env[(id(node), i)] = o
             if node.op.has_aux:
@@ -68,5 +45,120 @@ def lower_symbol(symbol, is_train: bool, group2ctx=None):
                     if inp.is_variable:
                         new_aux[inp.name] = val
         return [env[(id(n), i)] for n, i in outputs], new_aux
+
+    return fn
+
+
+def lower_symbol_grouped(symbol, is_train: bool, group2ctx, default_device):
+    """group2ctx model-parallel lowering (``graph_executor.cc:279-393``
+    AssignContext: PlaceDevice pass + ``_CrossDeviceCopy`` insertion;
+    ``example/model-parallel-lstm/lstm.py:65-68``).
+
+    TPU-native form of the reference's design: the topo-ordered node list
+    is partitioned into contiguous same-device *segments*; each segment is
+    compiled as its own jitted subprogram on its group's device, and the
+    eager driver inserts explicit ``jax.device_put`` transfers at segment
+    boundaries (the ``_CrossDeviceCopy`` nodes).  The driver itself is NOT
+    jittable — jax.jit refuses arguments committed to different devices —
+    but it IS differentiable: ``jax.vjp`` traces through the per-segment
+    jits and the transfers, moving cotangents back across the boundary.
+
+    Returns ``fn(arg_vals, aux_vals, key) -> (outputs, new_aux)`` to be
+    invoked eagerly (do not wrap in jax.jit).
+    """
+    import jax
+
+    nodes = symbol.topo_nodes()
+    outputs = symbol._outputs
+    aux_names = set(symbol.list_auxiliary_states())
+    var_by_id = {id(n): n for n in nodes if n.is_variable}
+
+    devmap = {g: ctx.jax_device for g, ctx in group2ctx.items()}
+
+    def node_dev(node):
+        grp = (node.attrs or {}).get("ctx_group")
+        if grp is not None and str(grp) in devmap:
+            return devmap[str(grp)]
+        return default_device
+
+    # ---- partition non-variable nodes into contiguous same-device segments
+    segs = []  # each: {dev, nodes: [(global_idx, node)]}
+    for ni, node in enumerate(nodes):
+        if node.is_variable:
+            continue
+        d = node_dev(node)
+        if not segs or segs[-1]["dev"] != d:
+            segs.append({"dev": d, "nodes": []})
+        segs[-1]["nodes"].append((ni, node))
+
+    out_entries = [(id(n), i) for n, i in outputs]
+    for seg in segs:
+        seg["ids"] = {id(node) for _, node in seg["nodes"]}
+        ext, seen = [], set()
+        for _, node in seg["nodes"]:
+            for inp, idx in node.inputs:
+                k = (id(inp), idx)
+                if id(inp) not in seg["ids"] and k not in seen:
+                    seen.add(k)
+                    ext.append(k)
+        seg["ext_keys"] = ext
+
+    # a segment exports only what crosses its boundary — entries consumed
+    # by OTHER segments or in the final outputs; same-segment intermediates
+    # stay inside the jit so XLA can fuse/rematerialize them
+    cross = set(out_entries)
+    for seg in segs:
+        cross.update(seg["ext_keys"])
+    for seg in segs:
+        seg["out_keys"] = sorted(k for k in cross if k[0] in seg["ids"])
+
+    def make_seg_fn(seg):
+        seg_nodes = seg["nodes"]
+        ext_keys = tuple(seg["ext_keys"])
+        out_keys = tuple(seg["out_keys"])
+
+        def seg_fn(ext_vals, key):
+            env = dict(zip(ext_keys, ext_vals))
+            upd = {}
+            for ni, node in seg_nodes:
+                ins = [env[(id(inp), idx)] for inp, idx in node.inputs]
+                rng = (jax.random.fold_in(key, ni)
+                       if node.op.needs_rng else None)
+                outs, naux = node.op.apply(
+                    ins, node.attrs, OpContext(is_train=is_train, rng=rng))
+                for i, o in enumerate(outs):
+                    env[(id(node), i)] = o
+                if node.op.has_aux:
+                    n_args = len(node.op.get_arg_names(node.attrs))
+                    for (inp, _), val in zip(node.inputs[n_args:], naux):
+                        if inp.is_variable:
+                            upd[inp.name] = val
+            return [env[k] for k in out_keys], upd
+
+        return jax.jit(seg_fn)
+
+    for seg in segs:
+        seg["fn"] = make_seg_fn(seg)
+
+    def fn(arg_vals, aux_vals, key):
+        aux_state = dict(aux_vals)
+        env = {}
+
+        def resolve(k):
+            var = var_by_id.get(k[0])
+            if var is not None:
+                return (aux_state[var.name] if var.name in aux_names
+                        else arg_vals[var.name])
+            return env[k]
+
+        for seg in segs:
+            dev = seg["dev"]
+            ext_vals = [jax.device_put(resolve(k), dev)
+                        for k in seg["ext_keys"]]
+            out_vals, upd = seg["fn"](ext_vals, jax.device_put(key, dev))
+            for k, v in zip(seg["out_keys"], out_vals):
+                env[k] = v
+            aux_state.update(upd)
+        return [resolve(k) for k in out_entries], aux_state
 
     return fn

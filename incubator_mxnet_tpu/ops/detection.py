@@ -600,6 +600,12 @@ def _proposal(ins, attrs, ctx):
         rois, scores = per_image(cls_prob[0], bbox_pred[0], im_info[0])
     else:
         rois, scores = jax.vmap(per_image)(cls_prob, bbox_pred, im_info)
+        # rois column 0 is the batch index consumed by ROIPooling
+        # (multi_proposal.cu PrepareOutput: out[index*5] = image_index)
+        img_idx = jnp.broadcast_to(
+            jnp.arange(batch, dtype=rois.dtype)[:, None, None],
+            rois.shape[:2] + (1,))
+        rois = jnp.concatenate([img_idx, rois[..., 1:]], axis=-1)
         rois = rois.reshape(-1, 5)
         scores = scores.reshape(-1, 1)
     if output_score:

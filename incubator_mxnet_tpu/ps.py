@@ -347,7 +347,12 @@ class PSServer(_Node):
 
     - sync mode: pushes accumulate into a merge buffer; when
       ``num_workers`` pushes arrived for a key, the updater runs ONCE and
-      pending pulls release (kvstore_dist_server.h:182+);
+      pending pulls release (kvstore_dist_server.h:182+).  Merges are
+      versioned with a per-key *round* counter (ps-lite timestamps): a
+      pull from worker ``w`` waits until every round ``w`` itself pushed
+      has been applied — NOT until the merge buffer drains — so a fast
+      worker's round-N+1 push arriving before a slow worker's round-N
+      pull cannot deadlock the slow worker;
     - async mode: each push updates immediately (``DataHandle`` async
       branch) — workers racing is the *intended* semantics.
     """
@@ -361,6 +366,8 @@ class PSServer(_Node):
         self.sync_mode = False
         self._store: Dict[Any, np.ndarray] = {}
         self._merge: Dict[Any, Tuple[np.ndarray, int]] = {}
+        self._round: Dict[Any, int] = {}    # applied merges per key
+        self._pushed: Dict[Any, Dict[int, int]] = {}  # key -> rank -> count
         self._updater: Optional[Callable] = None
         self._lock = threading.Condition()
 
@@ -410,22 +417,37 @@ class PSServer(_Node):
                 if not self.sync_mode:
                     self._apply(key, grad)
                 else:
+                    rank = msg.get("rank")
+                    if rank is not None:
+                        ranks = self._pushed.setdefault(key, {})
+                        ranks[rank] = ranks.get(rank, 0) + 1
                     buf, cnt = self._merge.get(key, (None, 0))
                     buf = grad.copy() if buf is None else buf + grad
                     cnt += 1
                     if cnt >= self.num_workers:
                         self._apply(key, buf)
                         self._merge[key] = (None, 0)
+                        self._round[key] = self._round.get(key, 0) + 1
                         self._lock.notify_all()
                     else:
                         self._merge[key] = (buf, cnt)
             return {"status": "ok"}
         if cmd == "pull":
             key = msg["key"]
+            rank = msg.get("rank")
             with self._lock:
                 if self.sync_mode:
-                    # release only after the round's merge completed
-                    while self._merge.get(key, (None, 0))[1] > 0:
+                    # release once every round THIS worker pushed has been
+                    # applied (per-key round versioning; waiting on the
+                    # merge buffer instead deadlocks across rounds when a
+                    # fast worker's next push lands first)
+                    def _ready():
+                        if rank is None:
+                            return self._merge.get(key, (None, 0))[1] == 0
+                        want = self._pushed.get(key, {}).get(rank, 0)
+                        return self._round.get(key, 0) >= want
+
+                    while not _ready():
                         if not self._lock.wait(timeout=300):
                             return {"status": "error",
                                     "error": "sync pull timeout"}
@@ -532,6 +554,7 @@ class PSClient:
         for sidx, subkey, sl in self._plan(key, value):
             reply = self._pool.rpc(self.servers[sidx],
                                    {"cmd": "push", "key": subkey,
+                                    "rank": self.rank,
                                     "value":
                                     np.ascontiguousarray(value[sl])})
             if reply["status"] != "ok":
@@ -541,7 +564,8 @@ class PSClient:
         out = np.empty_like(like)
         for sidx, subkey, sl in self._plan(key, like):
             reply = self._pool.rpc(self.servers[sidx],
-                                   {"cmd": "pull", "key": subkey})
+                                   {"cmd": "pull", "key": subkey,
+                                    "rank": self.rank})
             if reply["status"] != "ok":
                 raise MXNetError("pull failed: %s" % reply.get("error"))
             out[sl] = reply["value"]

@@ -375,6 +375,29 @@ def test_proposal():
     assert (np.diff(real) <= 1e-6).all()
 
 
+def test_multi_proposal_batch_image_index():
+    """Batch > 1 MultiProposal fills rois column 0 with the per-image
+    index (multi_proposal.cu PrepareOutput: out[index*5] = image_index) —
+    ROIPooling uses it as the batch index downstream."""
+    from incubator_mxnet_tpu.ops.registry import get_op
+    rng = np.random.RandomState(7)
+    B, A, fh, fw = 3, 3, 4, 4
+    post_n = 4
+    cls_prob = rng.rand(B, 2 * A, fh, fw).astype(np.float32)
+    bbox_pred = (rng.randn(B, 4 * A, fh, fw) * 0.1).astype(np.float32)
+    im_info = np.tile(np.array([[64, 64, 1.0]], np.float32), (B, 1))
+    attrs = {"feature_stride": "16", "scales": "(8,)",
+             "ratios": "(0.5, 1, 2)", "rpn_pre_nms_top_n": "12",
+             "rpn_post_nms_top_n": str(post_n), "threshold": "0.7",
+             "rpn_min_size": "4"}
+    op = get_op("_contrib_MultiProposal")
+    outs, _ = op.apply([cls_prob, bbox_pred, im_info], attrs)
+    rois = np.asarray(outs[0])
+    assert rois.shape == (B * post_n, 5)
+    expect = np.repeat(np.arange(B), post_n)
+    np.testing.assert_array_equal(rois[:, 0], expect)
+
+
 def test_multibox_symbolic_compose():
     """The three SSD ops compose into a symbolic graph and infer shapes
     (reference: example/ssd usage of the contrib symbols)."""

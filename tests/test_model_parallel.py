@@ -73,6 +73,59 @@ def test_group2ctx_places_outputs():
     assert out_dev == ctx2.jax_device, (out_dev, ctx2.jax_device)
 
 
+def test_model_parallel_lstm():
+    """The actual model-parallel LSTM pattern: stacked LSTM layers
+    assigned to different device groups via ``AttrScope(ctx_group=...)``
+    (``example/model-parallel-lstm/lstm.py:65-68``), numerically matching
+    the single-device executor."""
+    rng = np.random.RandomState(3)
+    seq_len, batch, nin, nh = 4, 2, 8, 12
+
+    def build():
+        data = mx.sym.Variable("data")
+        cells = []
+        net = data
+        for i in range(2):
+            with mx.AttrScope(ctx_group="layer%d" % i):
+                cell = mx.rnn.LSTMCell(nh, prefix="lstm%d_" % i)
+                outs, _ = cell.unroll(seq_len, inputs=net,
+                                      layout="NTC", merge_outputs=True)
+                net = outs
+                cells.append(cell)
+        with mx.AttrScope(ctx_group="out"):
+            net = mx.sym.mean(net, axis=1)
+            net = mx.sym.FullyConnected(net, num_hidden=4, name="out_fc")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    net = build()
+    g2c = {"layer0": mx.cpu(1), "layer1": mx.cpu(2), "out": mx.cpu(3)}
+    shapes = {"data": (batch, seq_len, nin), "softmax_label": (batch,)}
+    ex_mp = net.simple_bind(mx.cpu(0), grad_req="write",
+                            group2ctx=g2c, **shapes)
+    ex_sp = net.simple_bind(mx.cpu(0), grad_req="write", **shapes)
+
+    for name in ex_mp.arg_dict:
+        if name in shapes:
+            continue
+        v = rng.uniform(-0.1, 0.1,
+                        ex_mp.arg_dict[name].shape).astype(np.float32)
+        ex_mp.arg_dict[name][:] = mx.nd.array(v)
+        ex_sp.arg_dict[name][:] = mx.nd.array(v)
+    x = rng.randn(batch, seq_len, nin).astype(np.float32)
+    y = rng.randint(0, 4, batch).astype(np.float32)
+    for ex in (ex_mp, ex_sp):
+        ex.arg_dict["data"][:] = mx.nd.array(x)
+        ex.arg_dict["softmax_label"][:] = mx.nd.array(y)
+        ex.forward(is_train=True)
+        ex.backward()
+    np.testing.assert_allclose(ex_mp.outputs[0].asnumpy(),
+                               ex_sp.outputs[0].asnumpy(), rtol=1e-5)
+    for name in ex_mp.grad_dict:
+        np.testing.assert_allclose(ex_mp.grad_dict[name].asnumpy(),
+                                   ex_sp.grad_dict[name].asnumpy(),
+                                   rtol=1e-4, atol=1e-6)
+
+
 def test_model_parallel_lstm_style_fc_chain():
     """Layer-wise partition of an MLP across 4 'devices' trains and
     matches the single-device executor numerically (the model-parallel
