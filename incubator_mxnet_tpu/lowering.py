@@ -81,15 +81,38 @@ def lower_symbol_grouped(symbol, is_train: bool, group2ctx, default_device):
             return devmap[str(grp)]
         return default_device
 
-    # ---- partition non-variable nodes into contiguous same-device segments
-    segs = []  # each: {dev, nodes: [(global_idx, node)]}
-    for ni, node in enumerate(nodes):
+    # ---- partition into per-device *stages*, not contiguous topo runs: a
+    # node's stage only advances past its producers when the edge crosses
+    # devices, so all same-device nodes that can run together share ONE
+    # jitted segment (the PlaceDevice partition) even when the topo order
+    # interleaves groups (e.g. a time-unrolled model-parallel LSTM)
+    stage = {}
+    for node in nodes:
         if node.is_variable:
             continue
         d = node_dev(node)
-        if not segs or segs[-1]["dev"] != d:
-            segs.append({"dev": d, "nodes": []})
-        segs[-1]["nodes"].append((ni, node))
+        st = 0
+        for inp, _ in node.inputs:
+            if inp.is_variable:
+                continue
+            st = max(st, stage[id(inp)] if node_dev(inp) == d
+                     else stage[id(inp)] + 1)
+        stage[id(node)] = st
+
+    segs = []  # each: {dev, nodes: [(global_idx, node)]} in stage order
+    key2seg = {}
+    for ni, node in enumerate(nodes):
+        if node.is_variable:
+            continue
+        k = (stage[id(node)], node_dev(node))
+        seg = key2seg.get(k)
+        if seg is None:
+            seg = {"dev": node_dev(node), "stage": stage[id(node)],
+                   "nodes": []}
+            key2seg[k] = seg
+            segs.append(seg)
+        seg["nodes"].append((ni, node))
+    segs.sort(key=lambda s: s["stage"])  # stable within a stage
 
     out_entries = [(id(n), i) for n, i in outputs]
     for seg in segs:
@@ -161,4 +184,5 @@ def lower_symbol_grouped(symbol, is_train: bool, group2ctx, default_device):
             aux_state.update(upd)
         return [resolve(k) for k in out_entries], aux_state
 
+    fn._segments = segs  # introspection for tests/debugging
     return fn

@@ -40,12 +40,20 @@ def _binary_scalar(name, jfn, aliases=()):
         x = ins[0]
         # keep integer arrays integer for whole-number scalars (reference
         # semantics: output dtype follows the array operand)
-        if jnp.issubdtype(x.dtype, jnp.integer) and float(s).is_integer():
+        int_in = jnp.issubdtype(x.dtype, jnp.integer) \
+            and float(s).is_integer()
+        if int_in:
             s = jnp.asarray(int(s), dtype=x.dtype)
         else:
             s = jnp.asarray(s, dtype=x.dtype) \
                 if jnp.issubdtype(x.dtype, jnp.floating) else s
-        return _j(x, s)
+        out = _j(x, s)
+        if int_in and out.dtype != x.dtype:
+            # jnp true-division (and hypot) promote ints to float; the
+            # reference's mshadow kernels keep the array dtype (C
+            # truncation semantics)
+            out = out.astype(x.dtype)
+        return out
     return _f
 
 
@@ -144,6 +152,16 @@ def _clip(ins, attrs, ctx):
     return jnp.clip(ins[0], a_min, a_max)
 
 
+def _exact_div(x, s):
+    """True division for floats; exact C truncating division for int
+    operands (jnp.divide promotes ints to float32, which corrupts exact
+    quotients at |v| >= 2^24 — mshadow divides in the integer domain)."""
+    if jnp.issubdtype(jnp.result_type(x), jnp.integer) and \
+            jnp.issubdtype(jnp.result_type(s), jnp.integer):
+        return jax.lax.div(jnp.asarray(x), jnp.asarray(s))
+    return jnp.divide(x, s)
+
+
 # -- binary (same-shape in the reference; we broadcast like the broadcast_*
 #    variants so both namespaces share one kernel) --------------------------
 _binary("elemwise_add", jnp.add, aliases=["_plus", "_add", "broadcast_add",
@@ -152,7 +170,7 @@ _binary("elemwise_sub", jnp.subtract, aliases=["_minus", "_sub",
                                                "broadcast_sub",
                                                "broadcast_minus"])
 _binary("elemwise_mul", jnp.multiply, aliases=["_mul", "broadcast_mul"])
-_binary("elemwise_div", jnp.divide, aliases=["_div", "broadcast_div"])
+_binary("elemwise_div", _exact_div, aliases=["_div", "broadcast_div"])
 _binary("_mod", jnp.mod, aliases=["broadcast_mod"])
 _binary("_power", jnp.power, aliases=["_pow", "broadcast_power"])
 _binary("_maximum", jnp.maximum, aliases=["broadcast_maximum"])
@@ -183,8 +201,8 @@ _binary_scalar("_plus_scalar", jnp.add)
 _binary_scalar("_minus_scalar", jnp.subtract)
 _binary_scalar("_rminus_scalar", lambda x, s: s - x)
 _binary_scalar("_mul_scalar", jnp.multiply)
-_binary_scalar("_div_scalar", jnp.divide)
-_binary_scalar("_rdiv_scalar", lambda x, s: s / x)
+_binary_scalar("_div_scalar", _exact_div)
+_binary_scalar("_rdiv_scalar", lambda x, s: _exact_div(s, x))
 _binary_scalar("_mod_scalar", jnp.mod)
 _binary_scalar("_rmod_scalar", lambda x, s: jnp.mod(s, x))
 _binary_scalar("_power_scalar", jnp.power)

@@ -634,11 +634,18 @@ def _upsampling(ins, attrs, ctx):
     sample_type = attrs.get("sample_type", "nearest")
     x = ins[0]
     if sample_type == "nearest":
+        # output is (scale·h0, scale·w0); every other input is upsampled
+        # to that size (upsampling-inl.h num_args doc), then concat along
+        # channels or summed per multi_input_mode
+        out_h, out_w = x.shape[2] * scale, x.shape[3] * scale
         outs = []
         for x in ins:
-            y = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+            y = jnp.repeat(jnp.repeat(x, out_h // x.shape[2], axis=2),
+                           out_w // x.shape[3], axis=3)
             outs.append(y)
         if len(outs) > 1:
+            if attrs.get("multi_input_mode", "concat") == "sum":
+                return sum(outs[1:], outs[0])
             return jnp.concatenate(outs, axis=1)
         return outs[0]
     # bilinear via resize (weight input ignored: resize kernel is fixed)

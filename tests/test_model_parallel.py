@@ -126,6 +126,80 @@ def test_model_parallel_lstm():
                                    rtol=1e-4, atol=1e-6)
 
 
+def test_interleaved_groups_stay_coarse():
+    """A topo order that alternates device groups per step (time-unrolled
+    model-parallel pattern) must still partition into ONE jitted segment
+    per device stage, not per contiguous run — and match single-device
+    numerics."""
+    rng = np.random.RandomState(5)
+    T = 4
+    x0 = mx.sym.Variable("x0")
+    x1 = mx.sym.Variable("x1")
+    a, b = x0, x1
+    for t in range(T):
+        with mx.AttrScope(ctx_group="dev0"):
+            a = a * 2.0 + b  # layer0 step t (consumes layer1's previous)
+        with mx.AttrScope(ctx_group="dev1"):
+            b = b + a        # layer1 step t (consumes layer0's current)
+    net = a + b
+
+    g2c = {"dev0": mx.cpu(1), "dev1": mx.cpu(2)}
+    shape = (3, 4)
+    args = {k: mx.nd.array(rng.randn(*shape).astype(np.float32))
+            for k in ("x0", "x1")}
+    grads = {k: mx.nd.zeros(shape) for k in args}
+    ex = net.bind(mx.cpu(0), args=dict(args), args_grad=grads,
+                  group2ctx=g2c)
+    ex2 = net.bind(mx.cpu(0), args={k: mx.nd.array(v.asnumpy())
+                                    for k, v in args.items()},
+                   args_grad={k: mx.nd.zeros(shape) for k in args})
+    for e in (ex, ex2):
+        e.forward(is_train=True)
+        e.backward([mx.nd.ones(shape)])
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(),
+                               ex2.outputs[0].asnumpy(), rtol=1e-6)
+    for k in grads:
+        np.testing.assert_allclose(grads[k].asnumpy(),
+                                   ex2.grad_dict[k].asnumpy(), rtol=1e-5)
+    # stage-based partition: cross-device edges advance stages, but each
+    # (stage, device) is one segment — alternating T steps over 2 devices
+    # yields at most 2T+1 segments by construction and the final add sits
+    # on the default device; contiguous-run partitioning would also give
+    # ~2T, so assert the real invariant: segment count == number of
+    # distinct (stage, device) pairs and every same-stage pair is merged
+    segs = ex._get_fwd(True)._segments
+    keys = {(s["stage"], str(s["dev"])) for s in segs}
+    assert len(segs) == len(keys)
+    # dependency chain here forces alternation: a*2+b (dev0) needs the b
+    # of the previous stage, so stages strictly interleave — verify
+    # monotone stage order
+    stages = [s["stage"] for s in segs]
+    assert stages == sorted(stages)
+
+
+def test_parallel_branches_merge_into_one_segment():
+    """Independent same-device branches interleaved in topo order collapse
+    into one segment per device (the PlaceDevice partition), instead of
+    one segment per contiguous run."""
+    x = mx.sym.Variable("x")
+    outs = []
+    for i in range(4):  # alternate groups in construction order
+        with mx.AttrScope(ctx_group="dev%d" % (i % 2)):
+            outs.append(x * float(i + 1))
+    with mx.AttrScope(ctx_group="dev0"):
+        net = outs[0] + outs[1] + outs[2] + outs[3]
+    g2c = {"dev0": mx.cpu(1), "dev1": mx.cpu(2)}
+    ex = net.bind(mx.cpu(0), args={"x": mx.nd.ones((2, 2))},
+                  group2ctx=g2c)
+    ex.forward(is_train=False)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(),
+                               np.full((2, 2), 10.0), rtol=1e-6)
+    segs = ex._fwd_jit[False]._segments
+    # dev1's two independent branches merge (stage 0); dev0 has stage-0
+    # branches and the stage-1 adds -> exactly 3 segments
+    assert len(segs) == 3, [(s["stage"], len(s["nodes"])) for s in segs]
+
+
 def test_model_parallel_lstm_style_fc_chain():
     """Layer-wise partition of an MLP across 4 'devices' trains and
     matches the single-device executor numerically (the model-parallel
