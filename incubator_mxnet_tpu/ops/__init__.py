@@ -30,4 +30,5 @@ from . import optimizer_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import linalg  # noqa: F401
 from . import contrib_ops  # noqa: F401
+from . import contrib_vision  # noqa: F401
 from . import detection  # noqa: F401

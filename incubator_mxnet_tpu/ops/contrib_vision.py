@@ -1,0 +1,461 @@
+"""Contrib vision/sequence ops absent from the round-2 build: Correlation,
+CTCLoss, PSROIPooling, DeformablePSROIPooling, DeformableConvolution and
+krprod — each a static-shape XLA program (gathers + matmuls instead of the
+reference's hand-written CUDA kernels).
+
+Reference files:
+- ``src/operator/correlation-inl.h:45-120`` + ``correlation.cc:40-80``
+- ``src/operator/contrib/ctc_loss-inl.h:98-281`` (warp-ctc semantics:
+  blank = 0, labels 0-padded, activations get softmax inside the op)
+- ``src/operator/contrib/psroi_pooling-inl.h:51`` + ``psroi_pooling.cu:50``
+- ``src/operator/contrib/deformable_psroi_pooling-inl.h:51`` +
+  ``deformable_psroi_pooling.cu:71-170``
+- ``src/operator/contrib/deformable_convolution-inl.h:58`` +
+  ``nn/deformable_im2col.cuh`` (bilinear-offset im2col)
+- ``src/operator/contrib/krprod.h:49`` (row-wise Khatri-Rao)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import (register, parse_bool, parse_float, parse_int,
+                       parse_tuple)
+
+__all__ = []
+
+_NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet)
+# ---------------------------------------------------------------------------
+
+def _corr_geometry(shape, attrs):
+    h, w = shape[2], shape[3]
+    pad = parse_int(attrs.get("pad_size"), 0)
+    ksize = parse_int(attrs.get("kernel_size"), 1)
+    max_disp = parse_int(attrs.get("max_displacement"), 1)
+    s1 = parse_int(attrs.get("stride1"), 1)
+    s2 = parse_int(attrs.get("stride2"), 1)
+    kr = (ksize - 1) // 2
+    border = max_disp + kr
+    ph, pw = h + 2 * pad, w + 2 * pad
+    top_w = int(np.ceil((pw - border * 2) / s1))
+    top_h = int(np.ceil((ph - border * 2) / s1))
+    rad = max_disp // s2
+    grid_w = rad * 2 + 1
+    return (pad, ksize, max_disp, s1, s2, kr, top_h, top_w, rad, grid_w)
+
+
+def _correlation_infer_shape(in_shapes, attrs):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    (_, _, _, _, _, _, th, tw, _, gw) = _corr_geometry(d, attrs)
+    return [d, in_shapes[1] or d], [(d[0], gw * gw, th, tw)], []
+
+
+@register("Correlation", arg_names=["data1", "data2"],
+          infer_shape=_correlation_infer_shape)
+def _correlation(ins, attrs, ctx):
+    """Correlation of two feature maps over a displacement neighborhood
+    (``correlation.cc:40-80``): output channel (p, o) is the
+    kernel-window product (or abs-difference) of data1 at (y1, x1) with
+    data2 at (y1 + p·stride2, x1 + o·stride2), normalized by kernel²·C.
+    (y1, x1) is the window's top-left in the padded map, exactly as the
+    reference indexes ``tmp1[y1+h][x1+w]``."""
+    x1, x2 = ins
+    n, c, h, w = x1.shape
+    (pad, ksize, max_disp, s1, s2, kr, top_h, top_w, rad, grid_w) = \
+        _corr_geometry(x1.shape, attrs)
+    is_mult = parse_bool(attrs.get("is_multiply", True))
+    p1 = jnp.pad(x1, [(0, 0), (0, 0), (pad, pad), (pad, pad)])
+    p2 = jnp.pad(x2, [(0, 0), (0, 0), (pad, pad), (pad, pad)])
+    sumelems = ksize * ksize * c
+
+    ys = jnp.arange(top_h) * s1 + max_disp
+    xs = jnp.arange(top_w) * s1 + max_disp
+    ky = jnp.arange(ksize)
+    kx = jnp.arange(ksize)
+
+    def patches(img, dy, dx):
+        """(N, C, top_h, top_w, k, k) kernel windows displaced (dy, dx)."""
+        rows = (ys[:, None] + dy + ky[None, :])  # (top_h, k)
+        cols = (xs[:, None] + dx + kx[None, :])  # (top_w, k)
+        rows = rows[:, None, :, None]
+        cols = cols[None, :, None, :]
+        rows = jnp.broadcast_to(rows, (top_h, top_w, ksize, ksize))
+        cols = jnp.broadcast_to(cols, (top_h, top_w, ksize, ksize))
+        return img[:, :, rows, cols]
+
+    base = patches(p1, 0, 0)
+    outs = []
+    for p in range(-rad, rad + 1):
+        for o in range(-rad, rad + 1):
+            disp = patches(p2, p * s2, o * s2)
+            v = base * disp if is_mult else jnp.abs(base - disp)
+            outs.append(v.sum(axis=(1, 4, 5)) / sumelems)
+    return jnp.stack(outs, axis=1).astype(x1.dtype)
+
+
+# ---------------------------------------------------------------------------
+# CTCLoss (warp-ctc semantics)
+# ---------------------------------------------------------------------------
+
+def _ctc_infer_shape(in_shapes, attrs):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    return [d, in_shapes[1]], [(d[1],)], []
+
+
+@register("_contrib_CTCLoss", arg_names=["data", "label"],
+          aliases=["CTCLoss", "ctc_loss"], infer_shape=_ctc_infer_shape)
+def _ctc_loss(ins, attrs, ctx):
+    """CTC negative log-likelihood (``ctc_loss-inl.h``): data (T, N, C)
+    raw activations (softmax applied inside, warp-ctc contract), label
+    (N, L) 0-padded (0 is the blank).  Log-space alpha recursion as one
+    ``lax.scan``; the gradient is jax's autodiff of the loss — the same
+    (softmax − expected-counts) gradient warp-ctc computes analytically."""
+    data, labels = ins
+    T, N, C = data.shape
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    lab = jax.lax.stop_gradient(labels).astype(jnp.int32)
+    L = lab.shape[1]
+    lab_len = jnp.sum((lab != 0).astype(jnp.int32), axis=1)
+    S = 2 * L + 1
+    ext = jnp.zeros((N, S), jnp.int32).at[:, 1::2].set(lab)
+    s_valid = 2 * lab_len + 1
+    smask = jnp.arange(S)[None, :] < s_valid[:, None]
+    can_skip = jnp.zeros((N, S), bool).at[:, 2:].set(
+        (ext[:, 2:] != 0) & (ext[:, 2:] != ext[:, :-2]))
+
+    def emit(logp_t):
+        return jnp.take_along_axis(logp_t, ext, axis=1)  # (N, S)
+
+    alpha0 = jnp.full((N, S), _NEG, jnp.float32)
+    e0 = emit(logp[0])
+    alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+    if S > 1:
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, e0[:, 1], _NEG))
+    alpha0 = jnp.where(smask, alpha0, _NEG)
+
+    def step(alpha, logp_t):
+        e = emit(logp_t)
+        s1 = jnp.concatenate(
+            [jnp.full((N, 1), _NEG, alpha.dtype), alpha[:, :-1]], axis=1)
+        s2 = jnp.concatenate(
+            [jnp.full((N, 2), _NEG, alpha.dtype), alpha[:, :-2]], axis=1)
+        s2 = jnp.where(can_skip, s2, _NEG)
+        m = jnp.maximum(jnp.maximum(alpha, s1), s2)
+        tot = m + jnp.log(jnp.exp(alpha - m) + jnp.exp(s1 - m)
+                          + jnp.exp(s2 - m))
+        a = tot + e
+        return jnp.where(smask, a, _NEG), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, logp[1:])
+    last1 = jnp.take_along_axis(alpha, (s_valid - 1)[:, None], axis=1)[:, 0]
+    last2 = jnp.take_along_axis(
+        alpha, jnp.maximum(s_valid - 2, 0)[:, None], axis=1)[:, 0]
+    total = jnp.where(s_valid >= 2, jnp.logaddexp(last1, last2), last1)
+    return (-total).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling / DeformablePSROIPooling
+# ---------------------------------------------------------------------------
+
+def _psroi_infer_shape(in_shapes, attrs):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    out_dim = parse_int(attrs.get("output_dim"))
+    pooled = parse_int(attrs.get("pooled_size"))
+    r = in_shapes[1][0] if in_shapes[1] is not None else None
+    out = None if r is None else (r, out_dim, pooled, pooled)
+    return list(in_shapes), [out], []
+
+
+@register("_contrib_PSROIPooling", arg_names=["data", "rois"],
+          aliases=["PSROIPooling"], infer_shape=_psroi_infer_shape)
+def _psroi_pooling(ins, attrs, ctx):
+    """Position-sensitive ROI average pooling (``psroi_pooling.cu:50-116``):
+    output bin (ctop, ph, pw) averages input channel
+    (ctop·G + gh)·G + gw over the bin's integer footprint."""
+    data, rois = ins
+    n, channels, height, width = data.shape
+    scale = parse_float(attrs.get("spatial_scale"))
+    out_dim = parse_int(attrs.get("output_dim"))
+    pooled = parse_int(attrs.get("pooled_size"))
+    gsize = parse_int(attrs.get("group_size"), 0) or pooled
+
+    pidx = jnp.arange(pooled, dtype=jnp.float32)
+    g_of_p = jnp.clip((jnp.arange(pooled) * gsize) // pooled, 0, gsize - 1)
+
+    def one_roi(roi):
+        batch = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * scale
+        y1 = jnp.round(roi[2]) * scale
+        x2 = (jnp.round(roi[3]) + 1.0) * scale
+        y2 = (jnp.round(roi[4]) + 1.0) * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / pooled, rw / pooled
+        img = data[batch]  # (C, H, W)
+
+        hh = jnp.arange(height, dtype=jnp.float32)
+        ww = jnp.arange(width, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(pidx * bh + y1), 0, height)
+        hend = jnp.clip(jnp.ceil((pidx + 1) * bh + y1), 0, height)
+        wstart = jnp.clip(jnp.floor(pidx * bw + x1), 0, width)
+        wend = jnp.clip(jnp.ceil((pidx + 1) * bw + x1), 0, width)
+        hm = (hh[None, :] >= hstart[:, None]) & (hh[None, :] < hend[:, None])
+        wm = (ww[None, :] >= wstart[:, None]) & (ww[None, :] < wend[:, None])
+        # per-channel bin sums: (C, P, P)
+        sums = jnp.einsum("chw,ph,qw->cpq", img, hm.astype(img.dtype),
+                          wm.astype(img.dtype))
+        area = (hend - hstart)[:, None] * (wend - wstart)[None, :]
+        empty = (hend[:, None] <= hstart[:, None]) | \
+            (wend[None, :] <= wstart[None, :])
+        avg = jnp.where(empty[None], 0.0,
+                        sums / jnp.maximum(area, 1.0)[None])
+        # position-sensitive channel per (ctop, ph, pw)
+        cmap = (jnp.arange(out_dim)[:, None, None] * gsize
+                + g_of_p[None, :, None]) * gsize + g_of_p[None, None, :]
+        return avg[cmap, jnp.arange(pooled)[None, :, None],
+                   jnp.arange(pooled)[None, None, :]]
+
+    return jax.vmap(one_roi)(rois).astype(data.dtype)
+
+
+def _bilinear_clamped(img_c, y, x, height, width):
+    """Bilinear sample of img_c (H, W) with coords pre-clamped into the
+    map (``deformable_psroi_pooling.cu`` bilinear_interp contract)."""
+    y = jnp.clip(y, 0.0, height - 1.0)
+    x = jnp.clip(x, 0.0, width - 1.0)
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1 = jnp.minimum(y0 + 1, height - 1)
+    x1 = jnp.minimum(x0 + 1, width - 1)
+    wy, wx = y - y0, x - x0
+    y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+    x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+    return (img_c[y0i, x0i] * (1 - wy) * (1 - wx)
+            + img_c[y0i, x1i] * (1 - wy) * wx
+            + img_c[y1i, x0i] * wy * (1 - wx)
+            + img_c[y1i, x1i] * wy * wx)
+
+
+def _dpsroi_args(attrs):
+    if parse_bool(attrs.get("no_trans", False)):
+        return ["data", "rois"]
+    return ["data", "rois", "trans"]
+
+
+@register("_contrib_DeformablePSROIPooling", arg_names=_dpsroi_args,
+          aliases=["DeformablePSROIPooling"],
+          infer_shape=_psroi_infer_shape)
+def _deformable_psroi_pooling(ins, attrs, ctx):
+    """Deformable position-sensitive ROI pooling
+    (``deformable_psroi_pooling.cu:71-170``): each bin is shifted by a
+    learned normalized offset (trans · trans_std · roi size) and averaged
+    over sample_per_part² bilinear samples."""
+    data, rois = ins[0], ins[1]
+    trans = ins[2] if len(ins) > 2 else None
+    n, channels, height, width = data.shape
+    scale = parse_float(attrs.get("spatial_scale"))
+    out_dim = parse_int(attrs.get("output_dim"))
+    pooled = parse_int(attrs.get("pooled_size"))
+    gsize = parse_int(attrs.get("group_size"))
+    part = parse_int(attrs.get("part_size"), 0) or pooled
+    spp = parse_int(attrs.get("sample_per_part"), 1)
+    trans_std = parse_float(attrs.get("trans_std", 0.0))
+    no_trans = parse_bool(attrs.get("no_trans", False)) or trans is None
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    ch_per_class = max(out_dim // num_classes, 1)
+
+    p_idx = jnp.arange(pooled)
+    g_of_p = jnp.clip((p_idx * gsize) // pooled, 0, gsize - 1)
+    part_of_p = jnp.clip((p_idx * part) // pooled, 0, part - 1)
+
+    def one_roi(roi, roi_idx):
+        batch = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * scale - 0.5
+        y1 = jnp.round(roi[2]) * scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / pooled, rw / pooled
+        sbh, sbw = bh / spp, bw / spp
+        img = data[batch]
+
+        def one_cell(ctop, ph, pw):
+            cls = ctop // ch_per_class
+            if no_trans:
+                tx = jnp.asarray(0.0)
+                ty = jnp.asarray(0.0)
+            else:
+                tx = trans[roi_idx, cls * 2, part_of_p[ph],
+                           part_of_p[pw]] * trans_std
+                ty = trans[roi_idx, cls * 2 + 1, part_of_p[ph],
+                           part_of_p[pw]] * trans_std
+            wstart = pw * bw + x1 + tx * rw
+            hstart = ph * bh + y1 + ty * rh
+            c = (ctop * gsize + g_of_p[ph]) * gsize + g_of_p[pw]
+            iw = jnp.arange(spp, dtype=jnp.float32)
+            wg, hg = jnp.meshgrid(wstart + iw * sbw, hstart + iw * sbh)
+            valid = ((wg >= -0.5) & (wg <= width - 0.5)
+                     & (hg >= -0.5) & (hg <= height - 0.5))
+            vals = _bilinear_clamped(img[c], hg.reshape(-1), wg.reshape(-1),
+                                     height, width).reshape(spp, spp)
+            cnt = valid.sum()
+            return jnp.where(cnt == 0, 0.0,
+                             jnp.sum(vals * valid) / jnp.maximum(cnt, 1))
+
+        return jax.vmap(lambda ct: jax.vmap(lambda ph: jax.vmap(
+            lambda pw: one_cell(ct, ph, pw))(p_idx))(p_idx))(
+                jnp.arange(out_dim))
+
+    return jax.vmap(one_roi)(rois, jnp.arange(rois.shape[0])
+                             ).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution (DCN v1)
+# ---------------------------------------------------------------------------
+
+def _dconv_args(attrs):
+    if parse_bool(attrs.get("no_bias", False)):
+        return ["data", "offset", "weight"]
+    return ["data", "offset", "weight", "bias"]
+
+
+def _dconv_infer_shape(in_shapes, attrs):
+    data_s = in_shapes[0]
+    if data_s is None:
+        return in_shapes, [None], []
+    num_filter = parse_int(attrs.get("num_filter"))
+    num_group = parse_int(attrs.get("num_group"), 1)
+    dg = parse_int(attrs.get("num_deformable_group"), 1)
+    no_bias = parse_bool(attrs.get("no_bias", False))
+    kernel = parse_tuple(attrs.get("kernel"), 2)
+    stride = parse_tuple(attrs.get("stride") or (1, 1), 2)
+    pad = parse_tuple(attrs.get("pad") or (0, 0), 2)
+    dilate = parse_tuple(attrs.get("dilate") or (1, 1), 2)
+    oh = (data_s[2] + 2 * pad[0] - (dilate[0] * (kernel[0] - 1) + 1)) \
+        // stride[0] + 1
+    ow = (data_s[3] + 2 * pad[1] - (dilate[1] * (kernel[1] - 1) + 1)) \
+        // stride[1] + 1
+    w = (num_filter, data_s[1] // num_group) + tuple(kernel)
+    off = (data_s[0], dg * 2 * kernel[0] * kernel[1], oh, ow)
+    shapes = [data_s, off, w] + ([] if no_bias else [(num_filter,)])
+    return shapes, [(data_s[0], num_filter, oh, ow)], []
+
+
+@register("_contrib_DeformableConvolution", arg_names=_dconv_args,
+          aliases=["DeformableConvolution"], infer_shape=_dconv_infer_shape)
+def _deformable_convolution(ins, attrs, ctx):
+    """Deformable convolution v1 (``deformable_convolution-inl.h:58`` via
+    ``nn/deformable_im2col.cuh``): bilinear-sample the input at each
+    kernel tap displaced by the learned offsets (offset channels per
+    deformable group: [dy, dx] interleaved over taps), then one dense
+    grouped matmul with the weights — im2col product on the MXU.
+    Out-of-map corners contribute zero, matching the reference's
+    ``im2col_bilinear`` zero-padding."""
+    data, offset, weight = ins[0], ins[1], ins[2]
+    bias = ins[3] if len(ins) > 3 else None
+    n, cin, height, width = data.shape
+    kernel = parse_tuple(attrs.get("kernel"), 2)
+    stride = parse_tuple(attrs.get("stride") or (1, 1), 2)
+    pad = parse_tuple(attrs.get("pad") or (0, 0), 2)
+    dilate = parse_tuple(attrs.get("dilate") or (1, 1), 2)
+    num_group = parse_int(attrs.get("num_group"), 1)
+    dg = parse_int(attrs.get("num_deformable_group"), 1)
+    kh, kw = kernel
+    oh = (height + 2 * pad[0] - (dilate[0] * (kh - 1) + 1)) // stride[0] + 1
+    ow = (width + 2 * pad[1] - (dilate[1] * (kw - 1) + 1)) // stride[1] + 1
+
+    oy = jnp.arange(oh) * stride[0] - pad[0]
+    ox = jnp.arange(ow) * stride[1] - pad[1]
+    ky = jnp.arange(kh) * dilate[0]
+    kx = jnp.arange(kw) * dilate[1]
+    base_y = jnp.broadcast_to(
+        oy[None, None, :, None] + ky[:, None, None, None],
+        (kh, kw, oh, ow)).astype(jnp.float32)
+    base_x = jnp.broadcast_to(
+        ox[None, None, None, :] + kx[None, :, None, None],
+        (kh, kw, oh, ow)).astype(jnp.float32)
+
+    off = offset.reshape(n, dg, kh * kw, 2, oh, ow)
+    sy = base_y[None, None] + off[:, :, :, 0].reshape(n, dg, kh, kw, oh, ow)
+    sx = base_x[None, None] + off[:, :, :, 1].reshape(n, dg, kh, kw, oh, ow)
+    cpg_d = cin // dg
+
+    def sample_image(img, sy_i, sx_i):
+        """img (C, H, W); sy/sx (dg, kh, kw, oh, ow) →
+        (C, kh, kw, oh, ow) bilinear samples.  Exact
+        ``deformable_im2col`` semantics: a sample is zero unless its
+        coordinate is in [0, size) — (-1, 0) fringe contributes NOTHING
+        — and the last fractional row/column snaps to the edge pixel
+        with full weight (the h_low >= height-1 clamp resets lh to 0)."""
+
+        def per_dgroup(img_g, yy, xx):
+            y = yy.reshape(-1)
+            x = xx.reshape(-1)
+            inside = (y >= 0.0) & (y < height) & (x >= 0.0) & (x < width)
+            y0 = jnp.floor(y)
+            x0 = jnp.floor(x)
+            snap_y = y0 >= height - 1
+            snap_x = x0 >= width - 1
+            y0 = jnp.where(snap_y, height - 1.0, y0)
+            x0 = jnp.where(snap_x, width - 1.0, x0)
+            y1 = jnp.where(snap_y, height - 1.0, y0 + 1)
+            x1 = jnp.where(snap_x, width - 1.0, x0 + 1)
+            wy = jnp.where(snap_y, 0.0, y - y0)
+            wx = jnp.where(snap_x, 0.0, x - x0)
+
+            def at(yi, xi):
+                return img_g[:, jnp.clip(yi, 0, height - 1
+                                         ).astype(jnp.int32),
+                             jnp.clip(xi, 0, width - 1).astype(jnp.int32)]
+
+            v = (at(y0, x0) * (1 - wy) * (1 - wx)
+                 + at(y0, x1) * (1 - wy) * wx
+                 + at(y1, x0) * wy * (1 - wx)
+                 + at(y1, x1) * wy * wx)
+            v = v * inside[None, :]
+            return v.reshape((img_g.shape[0],) + yy.shape)
+
+        groups = img.reshape(dg, cpg_d, height, width)
+        out = jax.vmap(per_dgroup)(groups, sy_i, sx_i)
+        return out.reshape(cin, kh, kw, oh, ow)
+
+    cols = jax.vmap(sample_image)(data.astype(jnp.float32), sy, sx)
+    cpg = cin // num_group
+    fpg = weight.shape[0] // num_group
+    cols_g = cols.reshape(n, num_group, cpg * kh * kw, oh * ow)
+    w_g = weight.astype(jnp.float32).reshape(num_group, fpg, cpg * kh * kw)
+    y = jnp.einsum("ngkp,gfk->ngfp", cols_g, w_g)
+    y = y.reshape(n, weight.shape[0], oh, ow)
+    if bias is not None:
+        y = y + bias.astype(y.dtype).reshape(1, -1, 1, 1)
+    return y.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# krprod — row-wise Khatri-Rao product
+# ---------------------------------------------------------------------------
+
+@register("_contrib_krprod", arg_names=None, aliases=["khatri_rao"])
+def _krprod(ins, attrs, ctx):
+    """Row-wise Khatri-Rao product (``krprod.h:49`` row_wise_kronecker):
+    out[i] = kron(A[i], B[i], ...) for matrices sharing a row count."""
+    out = ins[0]
+    for m in ins[1:]:
+        r = out.shape[0]
+        out = (out[:, :, None] * m[:, None, :]).reshape(r, -1)
+    return out

@@ -1,0 +1,387 @@
+"""Numeric tests for the contrib vision/sequence tier: Correlation,
+CTCLoss, PSROIPooling, DeformablePSROIPooling, DeformableConvolution,
+krprod — numpy loop oracles transcribed from the reference kernels, plus
+brute-force path enumeration for CTC."""
+import itertools
+
+import numpy as np
+
+from incubator_mxnet_tpu.ops.registry import get_op
+
+from test_operator import apply_op, check_fwd, check_grad_fd
+
+
+# ---------------------------------------------------------------------------
+# Correlation — oracle from correlation.cc:40-80
+# ---------------------------------------------------------------------------
+
+def _np_correlation(d1, d2, pad, ksize, max_disp, s1, s2, is_mult):
+    n, c, h, w = d1.shape
+    kr = (ksize - 1) // 2
+    border = max_disp + kr
+    ph, pw = h + 2 * pad, w + 2 * pad
+    top_w = int(np.ceil((pw - 2 * border) / s1))
+    top_h = int(np.ceil((ph - 2 * border) / s1))
+    rad = max_disp // s2
+    gw = 2 * rad + 1
+    p1 = np.pad(d1.astype(np.float64), [(0, 0), (0, 0), (pad, pad),
+                                        (pad, pad)])
+    p2 = np.pad(d2.astype(np.float64), [(0, 0), (0, 0), (pad, pad),
+                                        (pad, pad)])
+    out = np.zeros((n, gw * gw, top_h, top_w))
+    sumelems = ksize * ksize * c
+    for i in range(top_h):
+        for j in range(top_w):
+            x1 = j * s1 + max_disp
+            y1 = i * s1 + max_disp
+            for tc in range(gw * gw):
+                s2o = (tc % gw - rad) * s2
+                s2p = (tc // gw - rad) * s2
+                x2, y2 = x1 + s2o, y1 + s2p
+                a = p1[:, :, y1:y1 + ksize, x1:x1 + ksize]
+                b = p2[:, :, y2:y2 + ksize, x2:x2 + ksize]
+                v = a * b if is_mult else np.abs(a - b)
+                out[:, tc, i, j] = v.sum(axis=(1, 2, 3)) / sumelems
+    return out
+
+
+def test_correlation():
+    rng = np.random.RandomState(0)
+    d1 = rng.randn(2, 3, 6, 6).astype(np.float32)
+    d2 = rng.randn(2, 3, 6, 6).astype(np.float32)
+    attrs = {"kernel_size": "1", "max_displacement": "2", "stride1": "1",
+             "stride2": "1", "pad_size": "2"}
+    want = _np_correlation(d1, d2, 2, 1, 2, 1, 1, True)
+    check_fwd("Correlation", [d1, d2], want, attrs, rtol=1e-4, atol=1e-4)
+    # kernel window > 1, strides > 1, abs-difference mode
+    attrs2 = {"kernel_size": "3", "max_displacement": "2", "stride1": "2",
+              "stride2": "2", "pad_size": "3", "is_multiply": "0"}
+    want2 = _np_correlation(d1, d2, 3, 3, 2, 2, 2, False)
+    check_fwd("Correlation", [d1, d2], want2, attrs2, rtol=1e-4, atol=1e-4)
+    # shape inference
+    op = get_op("Correlation")
+    _, outs, _ = op.infer_shape([(2, 3, 6, 6), (2, 3, 6, 6)], attrs)
+    assert outs[0] == want.shape
+    check_grad_fd("Correlation", [d1[:1, :1, :4, :4], d2[:1, :1, :4, :4]],
+                  {"kernel_size": "1", "max_displacement": "1",
+                   "pad_size": "1"}, wrt=(0, 1))
+
+
+# ---------------------------------------------------------------------------
+# CTCLoss — brute-force path enumeration oracle
+# ---------------------------------------------------------------------------
+
+def _collapse(path):
+    out = []
+    prev = None
+    for s in path:
+        if s != prev and s != 0:
+            out.append(s)
+        prev = s
+    return tuple(out)
+
+
+def _np_ctc_loss(data, labels):
+    """-log P(label) by enumerating every alignment path (tiny T/C only)."""
+    T, N, C = data.shape
+    e = np.exp(data.astype(np.float64)
+               - data.astype(np.float64).max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    losses = []
+    for b in range(N):
+        target = tuple(int(v) for v in labels[b] if v != 0)
+        p_total = 0.0
+        for path in itertools.product(range(C), repeat=T):
+            if _collapse(path) == target:
+                p = 1.0
+                for t, s in enumerate(path):
+                    p *= probs[t, b, s]
+                p_total += p
+        losses.append(-np.log(p_total))
+    return np.array(losses)
+
+
+def test_ctc_loss():
+    rng = np.random.RandomState(1)
+    T, N, C, L = 4, 3, 3, 2
+    data = rng.randn(T, N, C).astype(np.float32)
+    labels = np.array([[1, 2], [1, 0], [2, 2]], np.float32)  # 0 = pad/blank
+    want = _np_ctc_loss(data, labels)
+    for name in ("_contrib_CTCLoss", "CTCLoss", "ctc_loss"):
+        check_fwd(name, [data, labels], want, rtol=1e-4, atol=1e-4)
+    # gradient flows through the activations (finite-diff check)
+    check_grad_fd("ctc_loss", [data[:, :1], labels[:1]], wrt=(0,))
+    op = get_op("_contrib_CTCLoss")
+    _, outs, _ = op.infer_shape([(T, N, C), (N, L)], {})
+    assert outs[0] == (N,)
+
+
+def test_ctc_loss_longer_alphabet():
+    rng = np.random.RandomState(2)
+    T, N, C = 5, 2, 4
+    data = rng.randn(T, N, C).astype(np.float32)
+    labels = np.array([[3, 1, 0], [2, 0, 0]], np.float32)
+    want = _np_ctc_loss(data, labels)
+    check_fwd("ctc_loss", [data, labels], want, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling — oracle from psroi_pooling.cu:50-116
+# ---------------------------------------------------------------------------
+
+def _np_psroi_pool(data, rois, scale, out_dim, pooled, gsize):
+    n, channels, height, width = data.shape
+    r = rois.shape[0]
+    out = np.zeros((r, out_dim, pooled, pooled))
+    for ri in range(r):
+        batch = int(rois[ri, 0])
+        x1 = round(float(rois[ri, 1])) * scale
+        y1 = round(float(rois[ri, 2])) * scale
+        x2 = (round(float(rois[ri, 3])) + 1.0) * scale
+        y2 = (round(float(rois[ri, 4])) + 1.0) * scale
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        bh, bw = rh / pooled, rw / pooled
+        for ct in range(out_dim):
+            for ph in range(pooled):
+                for pw in range(pooled):
+                    hs = min(max(int(np.floor(ph * bh + y1)), 0), height)
+                    he = min(max(int(np.ceil((ph + 1) * bh + y1)), 0),
+                             height)
+                    ws = min(max(int(np.floor(pw * bw + x1)), 0), width)
+                    we = min(max(int(np.ceil((pw + 1) * bw + x1)), 0),
+                             width)
+                    gh = min(max(ph * gsize // pooled, 0), gsize - 1)
+                    gw = min(max(pw * gsize // pooled, 0), gsize - 1)
+                    c = (ct * gsize + gh) * gsize + gw
+                    if he <= hs or we <= ws:
+                        continue
+                    win = data[batch, c, hs:he, ws:we].astype(np.float64)
+                    out[ri, ct, ph, pw] = win.sum() / ((he - hs) * (we - ws))
+    return out
+
+
+def test_psroi_pooling():
+    rng = np.random.RandomState(3)
+    out_dim, gsize, pooled = 2, 3, 3
+    data = rng.randn(2, out_dim * gsize * gsize, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 31, 31],
+                     [1, 8, 4, 24, 28],
+                     [0, 14, 14, 15, 15]], np.float32)
+    scale = 0.25
+    want = _np_psroi_pool(data, rois, scale, out_dim, pooled, gsize)
+    attrs = {"spatial_scale": str(scale), "output_dim": str(out_dim),
+             "pooled_size": str(pooled), "group_size": str(gsize)}
+    for name in ("_contrib_PSROIPooling", "PSROIPooling"):
+        check_fwd(name, [data, rois], want, attrs, rtol=1e-4, atol=1e-4)
+    op = get_op("_contrib_PSROIPooling")
+    _, outs, _ = op.infer_shape([data.shape, rois.shape], attrs)
+    assert outs[0] == (3, out_dim, pooled, pooled)
+    check_grad_fd("PSROIPooling",
+                  [data[:1, :, :4, :4] * 0.1, rois[:1]], attrs, wrt=(0,))
+
+
+# ---------------------------------------------------------------------------
+# DeformablePSROIPooling
+# ---------------------------------------------------------------------------
+
+def _np_dpsroi_pool(data, rois, trans, scale, out_dim, pooled, gsize,
+                    part, spp, trans_std):
+    n, channels, height, width = data.shape
+    r = rois.shape[0]
+    num_classes = 1 if trans is None else trans.shape[1] // 2
+    cpc = max(out_dim // num_classes, 1)
+    out = np.zeros((r, out_dim, pooled, pooled))
+
+    def bil(img, h, w):
+        h = min(max(h, 0.0), height - 1.0)
+        w = min(max(w, 0.0), width - 1.0)
+        h0, w0 = int(np.floor(h)), int(np.floor(w))
+        h1, w1 = min(h0 + 1, height - 1), min(w0 + 1, width - 1)
+        lh, lw = h - h0, w - w0
+        return (img[h0, w0] * (1 - lh) * (1 - lw)
+                + img[h0, w1] * (1 - lh) * lw
+                + img[h1, w0] * lh * (1 - lw)
+                + img[h1, w1] * lh * lw)
+
+    for ri in range(r):
+        batch = int(rois[ri, 0])
+        x1 = round(float(rois[ri, 1])) * scale - 0.5
+        y1 = round(float(rois[ri, 2])) * scale - 0.5
+        x2 = (round(float(rois[ri, 3])) + 1.0) * scale - 0.5
+        y2 = (round(float(rois[ri, 4])) + 1.0) * scale - 0.5
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        bh, bw = rh / pooled, rw / pooled
+        sbh, sbw = bh / spp, bw / spp
+        for ct in range(out_dim):
+            cls = ct // cpc
+            for ph in range(pooled):
+                for pw in range(pooled):
+                    part_h = min(max(ph * part // pooled, 0), part - 1)
+                    part_w = min(max(pw * part // pooled, 0), part - 1)
+                    if trans is None:
+                        tx = ty = 0.0
+                    else:
+                        tx = trans[ri, cls * 2, part_h, part_w] * trans_std
+                        ty = trans[ri, cls * 2 + 1, part_h,
+                                   part_w] * trans_std
+                    ws = pw * bw + x1 + tx * rw
+                    hs = ph * bh + y1 + ty * rh
+                    gh = min(max(ph * gsize // pooled, 0), gsize - 1)
+                    gw = min(max(pw * gsize // pooled, 0), gsize - 1)
+                    c = (ct * gsize + gh) * gsize + gw
+                    tot, cnt = 0.0, 0
+                    for ih in range(spp):
+                        for iw in range(spp):
+                            w = ws + iw * sbw
+                            h = hs + ih * sbh
+                            if (w < -0.5 or w > width - 0.5 or h < -0.5
+                                    or h > height - 0.5):
+                                continue
+                            tot += bil(
+                                data[batch, c].astype(np.float64), h, w)
+                            cnt += 1
+                    out[ri, ct, ph, pw] = 0.0 if cnt == 0 else tot / cnt
+    return out
+
+
+def test_deformable_psroi_pooling():
+    rng = np.random.RandomState(4)
+    out_dim, gsize, pooled, spp = 2, 2, 2, 2
+    data = rng.randn(1, out_dim * gsize * gsize, 8, 8).astype(np.float32)
+    rois = np.array([[0, 2, 2, 28, 24], [0, 0, 0, 31, 31]], np.float32)
+    scale = 0.25
+    base_attrs = {"spatial_scale": str(scale), "output_dim": str(out_dim),
+                  "pooled_size": str(pooled), "group_size": str(gsize),
+                  "sample_per_part": str(spp)}
+    # no_trans path
+    attrs = dict(base_attrs, no_trans="1")
+    want = _np_dpsroi_pool(data, rois, None, scale, out_dim, pooled,
+                           gsize, pooled, spp, 0.0)
+    for name in ("_contrib_DeformablePSROIPooling",
+                 "DeformablePSROIPooling"):
+        check_fwd(name, [data, rois], want, attrs, rtol=1e-4, atol=1e-4)
+    # learned offsets
+    trans = (rng.rand(2, 2, pooled, pooled).astype(np.float32) - 0.5)
+    attrs_t = dict(base_attrs, trans_std="0.2")
+    want_t = _np_dpsroi_pool(data, rois, trans, scale, out_dim, pooled,
+                             gsize, pooled, spp, 0.2)
+    check_fwd("DeformablePSROIPooling", [data, rois, trans], want_t,
+              attrs_t, rtol=1e-4, atol=1e-4)
+    # zero trans == no_trans
+    zero = np.zeros_like(trans)
+    check_fwd("DeformablePSROIPooling", [data, rois, zero], want,
+              attrs_t, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+
+def test_deformable_convolution_zero_offset_matches_conv():
+    rng = np.random.RandomState(5)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    attrs = {"kernel": "(3, 3)", "num_filter": "3", "stride": "(1, 1)",
+             "pad": "(1, 1)"}
+    off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    conv = apply_op("Convolution", [x, w, b], attrs)[0]
+    for name in ("_contrib_DeformableConvolution", "DeformableConvolution"):
+        out = apply_op(name, [x, off, w, b], attrs)[0]
+        np.testing.assert_allclose(out, conv, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_convolution_integer_shift():
+    """A constant integer offset equals convolving a shifted input."""
+    rng = np.random.RandomState(6)
+    x = rng.randn(1, 1, 8, 8).astype(np.float32)
+    w = rng.randn(2, 1, 3, 3).astype(np.float32)
+    attrs = {"kernel": "(3, 3)", "num_filter": "2", "no_bias": "1"}
+    # shift all sampling one pixel right (dx = 1): same as shifting the
+    # input left by one column
+    off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    off[:, 1::2] = 1.0
+    out = apply_op("DeformableConvolution", [x, off, w], attrs)[0]
+    x_shift = np.zeros_like(x)
+    x_shift[:, :, :, :-1] = x[:, :, :, 1:]
+    want = apply_op("Convolution", [x_shift, w], attrs)[0]
+    # interior columns match exactly (border column differs: deformable
+    # samples the true pixel beyond the crop, the shifted input zero-pads)
+    np.testing.assert_allclose(out[..., :-1], want[..., :-1],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_convolution_fractional_offset_and_grad():
+    rng = np.random.RandomState(7)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    w = rng.randn(2, 2, 3, 3).astype(np.float32)
+    off = (rng.rand(1, 2 * 9, 3, 3).astype(np.float32) - 0.5)
+    attrs = {"kernel": "(3, 3)", "num_filter": "2", "no_bias": "1"}
+    out = apply_op("DeformableConvolution", [x, off, w], attrs)[0]
+    assert out.shape == (1, 2, 3, 3)
+    check_grad_fd("DeformableConvolution",
+                  [x, off * 0.3, w], attrs, wrt=(0, 1, 2),
+                  rtol=5e-2, atol=5e-2)
+    op = get_op("_contrib_DeformableConvolution")
+    shapes, outs, _ = op.infer_shape(
+        [(1, 2, 5, 5), None, None],
+        {"kernel": "(3, 3)", "num_filter": "2", "no_bias": "1"})
+    assert outs[0] == (1, 2, 3, 3)
+    assert shapes[1] == (1, 18, 3, 3) and shapes[2] == (2, 2, 3, 3)
+
+
+def test_deformable_convolution_edge_semantics():
+    """Exact deformable_im2col edge behavior: a sample at coordinate in
+    (-1, 0) is zero (validity gate is >= 0), and a sample in the last
+    fractional row snaps to the edge pixel with FULL weight (the
+    h_low >= height-1 clamp resets lh to 0)."""
+    x = np.zeros((1, 1, 2, 1), np.float32)
+    x[0, 0, 0, 0] = 7.0
+    x[0, 0, 1, 0] = 5.0
+    w = np.ones((1, 1, 1, 1), np.float32)
+    attrs = {"kernel": "(1, 1)", "num_filter": "1", "no_bias": "1"}
+    # dy = -0.5 at the top pixel -> coordinate -0.5 -> exactly 0
+    off = np.zeros((1, 2, 2, 1), np.float32)
+    off[0, 0] = -0.5
+    out = apply_op("DeformableConvolution", [x, off, w], attrs)[0]
+    assert out[0, 0, 0, 0] == 0.0, out
+    # dy = +0.5 at the bottom pixel -> 1.5 -> snaps to row 1, full weight
+    off2 = np.zeros((1, 2, 2, 1), np.float32)
+    off2[0, 0] = 0.5
+    out2 = apply_op("DeformableConvolution", [x, off2, w], attrs)[0]
+    np.testing.assert_allclose(out2[0, 0, 1, 0], 5.0, rtol=1e-6)
+    # interior fractional sample still interpolates: row 0 at y=0.5
+    np.testing.assert_allclose(out2[0, 0, 0, 0], 6.0, rtol=1e-6)
+
+
+def test_deformable_convolution_groups():
+    rng = np.random.RandomState(8)
+    x = rng.randn(1, 4, 5, 5).astype(np.float32)
+    w = rng.randn(4, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 2 * 9, 3, 3), np.float32)
+    attrs = {"kernel": "(3, 3)", "num_filter": "4", "num_group": "2",
+             "num_deformable_group": "2", "no_bias": "1"}
+    out = apply_op("DeformableConvolution", [x, off, w], attrs)[0]
+    want = apply_op("Convolution", [x, w],
+                    {"kernel": "(3, 3)", "num_filter": "4",
+                     "num_group": "2", "no_bias": "1"})[0]
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# krprod
+# ---------------------------------------------------------------------------
+
+def test_krprod():
+    rng = np.random.RandomState(9)
+    a = rng.randn(3, 2).astype(np.float32)
+    b = rng.randn(3, 4).astype(np.float32)
+    c = rng.randn(3, 2).astype(np.float32)
+    want2 = np.stack([np.kron(a[i], b[i]) for i in range(3)])
+    for name in ("_contrib_krprod", "khatri_rao"):
+        check_fwd(name, [a, b], want2, rtol=1e-5, atol=1e-5)
+    want3 = np.stack([np.kron(np.kron(a[i], b[i]), c[i]) for i in range(3)])
+    check_fwd("_contrib_krprod", [a, b, c], want3, rtol=1e-5, atol=1e-5)
+    check_fwd("_contrib_krprod", [a], a)

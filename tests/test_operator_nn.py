@@ -743,6 +743,18 @@ NN_COVERED = {
 # ops exercised (numeric asserts) by other dedicated test files
 COVERED_ELSEWHERE = {
     "Custom": "test_custom_op.py",
+    "Correlation": "test_contrib_vision.py",
+    "_contrib_CTCLoss": "test_contrib_vision.py",
+    "CTCLoss": "test_contrib_vision.py",
+    "ctc_loss": "test_contrib_vision.py",
+    "_contrib_PSROIPooling": "test_contrib_vision.py",
+    "PSROIPooling": "test_contrib_vision.py",
+    "_contrib_DeformablePSROIPooling": "test_contrib_vision.py",
+    "DeformablePSROIPooling": "test_contrib_vision.py",
+    "_contrib_DeformableConvolution": "test_contrib_vision.py",
+    "DeformableConvolution": "test_contrib_vision.py",
+    "_contrib_krprod": "test_contrib_vision.py",
+    "khatri_rao": "test_contrib_vision.py",
     "MultiBoxPrior": "test_detection.py",
     "MultiBoxTarget": "test_detection.py",
     "MultiBoxDetection": "test_detection.py",
