@@ -9,8 +9,16 @@ Runs the TPU-native fused train step (forward+backward+SGD in one XLA
 program, bf16 matmuls) on whatever single chip is the default jax backend.
 Prints ONE JSON line.
 
-Env knobs: TP_BENCH_BATCH (default 64), TP_BENCH_STEPS (default 20),
-TP_BENCH_SMALL=1 (tiny shapes for CPU smoke).
+Timing methodology (PERF.md): on the experimental axon remote platform
+``jax.block_until_ready`` does NOT reliably block until device execution
+finishes — timing loops fenced only by it measure *dispatch* rate, which
+is how round 2 recorded 30.6k img/s while the device trace showed ~2k.
+Every timed region here ends with a host readback of a value that depends
+on the LAST step's parameter update, which is a true execution fence.
+
+Env knobs: TP_BENCH_BATCH (default 256 — the honest-throughput optimum,
+PERF.md §4), TP_BENCH_STEPS (default 20), TP_BENCH_LAYOUT (NHWC default,
+NCHW for the layout A/B), TP_BENCH_SMALL=1 (tiny shapes for CPU smoke).
 """
 from __future__ import annotations
 
@@ -23,10 +31,18 @@ import numpy as np
 BASELINE_IMG_S = 181.53  # P100 ResNet-50 train b32 (docs/how_to/perf.md)
 
 
+def _sync(step):
+    """True execution fence: pull one scalar that depends on the latest
+    parameter update back to the host."""
+    name = next(iter(step.params))
+    return float(np.asarray(step.params[name]).ravel()[0])
+
+
 def main():
     small = os.environ.get("TP_BENCH_SMALL") == "1"
-    batch = int(os.environ.get("TP_BENCH_BATCH", "8" if small else "64"))
+    batch = int(os.environ.get("TP_BENCH_BATCH", "8" if small else "256"))
     steps = int(os.environ.get("TP_BENCH_STEPS", "3" if small else "20"))
+    layout = os.environ.get("TP_BENCH_LAYOUT", "NHWC")
     image = (3, 32, 32) if small else (3, 224, 224)
     classes = 10 if small else 1000
     layers = 18 if small else 50
@@ -37,8 +53,9 @@ def main():
     from incubator_mxnet_tpu import parallel
 
     net = mx.models.resnet(num_layers=layers, num_classes=classes,
-                           image_shape=image,
+                           image_shape=image, layout=layout,
                            dtype="float32" if small else "bfloat16")
+    image = mx.models.image_data_shape(image, layout)
     mesh = parallel.default_mesh(1)
     step = parallel.FusedTrainStep(
         net, {"data": (batch,) + image}, {"softmax_label": (batch,)},
@@ -60,14 +77,15 @@ def main():
                            data_parallel_spec(mesh, 1))
     batch_dict = {"data": data, "softmax_label": label}
 
-    # warmup (compile)
-    outs = step(batch_dict)
-    jax.block_until_ready(outs[0])
+    # warmup (compile) + drain any queued work with a real fence
+    step(batch_dict)
+    step(batch_dict)
+    _sync(step)
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        outs = step(batch_dict)
-    jax.block_until_ready(outs[0])
+        step(batch_dict)
+    _sync(step)  # fence on the final parameter update
     dt = time.perf_counter() - t0
 
     img_s = batch * steps / dt
@@ -76,7 +94,9 @@ def main():
                   else "resnet18_cifar_train_imgs_per_sec",
         "value": round(img_s, 2),
         "unit": "img/s",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        # the P100 anchor is a ResNet-50 number; small mode runs a
+        # different net, so the ratio would be meaningless there
+        "vs_baseline": None if small else round(img_s / BASELINE_IMG_S, 3),
     }))
 
 

@@ -17,7 +17,16 @@ from .ssd import ssd_300, get_symbol_train as ssd_train, \
 
 __all__ = ["lenet", "mlp", "alexnet", "resnet", "vgg", "inception_bn",
            "lstm_ptb", "lstm_ptb_sym_gen", "ssd_300", "ssd_train",
-           "ssd_deploy", "get_symbol"]
+           "ssd_deploy", "get_symbol", "image_data_shape"]
+
+
+def image_data_shape(image_shape, layout="NCHW"):
+    """The data-variable shape (sans batch) for a CLI-style channels-first
+    ``image_shape`` under the given layout — single source of the
+    CHW→HWC convention used by ``resnet(layout="NHWC")`` and bench."""
+    if layout == "NHWC":
+        return (image_shape[1], image_shape[2], image_shape[0])
+    return tuple(image_shape)
 
 _ZOO = {"lenet": lenet, "mlp": mlp, "alexnet": alexnet, "resnet": resnet,
         "vgg": vgg, "inception-bn": inception_bn,

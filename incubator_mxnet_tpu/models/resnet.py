@@ -2,88 +2,101 @@
 — the flagship: BASELINE configs 2 and 5 (single-chip + v5p-32 dist_sync)
 target ResNet-50 ImageNet ≥50% MFU.
 
-TPU note: the symbol keeps the reference's logical NCHW layout; XLA's layout
-assignment maps convs to MXU-friendly tilings.  bf16 training uses the
-``dtype`` argument (cast at input + cast back before softmax), matching how
-the reference used fp16 (``train_imagenet.py --dtype float16``).
+TPU note: ``layout`` selects NCHW (reference default) or NHWC — the
+channels-last layout the MXU natively tiles (reference ConvolutionParam also
+exposed a layout option).  NHWC is what the benchmark uses (PERF.md).  bf16
+training uses the ``dtype`` argument (cast at input + cast back before
+softmax), matching how the reference used fp16
+(``train_imagenet.py --dtype float16``).
 """
 from .. import symbol as sym
 
 
+def _bn_axis(layout):
+    return 3 if layout == "NHWC" else 1
+
+
 def residual_unit(data, num_filter, stride, dim_match, name,
-                  bottle_neck=True, bn_mom=0.9, workspace=256, memonger=False):
+                  bottle_neck=True, bn_mom=0.9, workspace=256,
+                  memonger=False, layout="NCHW"):
     """A residual block (pre-activation, v2 — reference residual_unit)."""
+    ax = _bn_axis(layout)
     if bottle_neck:
-        bn1 = sym.BatchNorm(data, fix_gamma=False, eps=2e-5,
+        bn1 = sym.BatchNorm(data, fix_gamma=False, eps=2e-5, axis=ax,
                             momentum=bn_mom, name=name + "_bn1")
         act1 = sym.Activation(bn1, act_type="relu", name=name + "_relu1")
         conv1 = sym.Convolution(act1, num_filter=int(num_filter * 0.25),
                                 kernel=(1, 1), stride=(1, 1), pad=(0, 0),
-                                no_bias=True, name=name + "_conv1")
-        bn2 = sym.BatchNorm(conv1, fix_gamma=False, eps=2e-5,
+                                no_bias=True, layout=layout,
+                                name=name + "_conv1")
+        bn2 = sym.BatchNorm(conv1, fix_gamma=False, eps=2e-5, axis=ax,
                             momentum=bn_mom, name=name + "_bn2")
         act2 = sym.Activation(bn2, act_type="relu", name=name + "_relu2")
         conv2 = sym.Convolution(act2, num_filter=int(num_filter * 0.25),
                                 kernel=(3, 3), stride=stride, pad=(1, 1),
-                                no_bias=True, name=name + "_conv2")
-        bn3 = sym.BatchNorm(conv2, fix_gamma=False, eps=2e-5,
+                                no_bias=True, layout=layout,
+                                name=name + "_conv2")
+        bn3 = sym.BatchNorm(conv2, fix_gamma=False, eps=2e-5, axis=ax,
                             momentum=bn_mom, name=name + "_bn3")
         act3 = sym.Activation(bn3, act_type="relu", name=name + "_relu3")
         conv3 = sym.Convolution(act3, num_filter=num_filter, kernel=(1, 1),
                                 stride=(1, 1), pad=(0, 0), no_bias=True,
-                                name=name + "_conv3")
+                                layout=layout, name=name + "_conv3")
         if dim_match:
             shortcut = data
         else:
             shortcut = sym.Convolution(act1, num_filter=num_filter,
                                        kernel=(1, 1), stride=stride,
-                                       no_bias=True, name=name + "_sc")
+                                       no_bias=True, layout=layout,
+                                       name=name + "_sc")
         return conv3 + shortcut
     bn1 = sym.BatchNorm(data, fix_gamma=False, momentum=bn_mom, eps=2e-5,
-                        name=name + "_bn1")
+                        axis=ax, name=name + "_bn1")
     act1 = sym.Activation(bn1, act_type="relu", name=name + "_relu1")
     conv1 = sym.Convolution(act1, num_filter=num_filter, kernel=(3, 3),
                             stride=stride, pad=(1, 1), no_bias=True,
-                            name=name + "_conv1")
+                            layout=layout, name=name + "_conv1")
     bn2 = sym.BatchNorm(conv1, fix_gamma=False, momentum=bn_mom, eps=2e-5,
-                        name=name + "_bn2")
+                        axis=ax, name=name + "_bn2")
     act2 = sym.Activation(bn2, act_type="relu", name=name + "_relu2")
     conv2 = sym.Convolution(act2, num_filter=num_filter, kernel=(3, 3),
                             stride=(1, 1), pad=(1, 1), no_bias=True,
-                            name=name + "_conv2")
+                            layout=layout, name=name + "_conv2")
     if dim_match:
         shortcut = data
     else:
         shortcut = sym.Convolution(act1, num_filter=num_filter,
                                    kernel=(1, 1), stride=stride,
-                                   no_bias=True, name=name + "_sc")
+                                   no_bias=True, layout=layout,
+                                   name=name + "_sc")
     return conv2 + shortcut
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
            bottle_neck=True, bn_mom=0.9, workspace=256, dtype="float32",
-           memonger=False):
+           memonger=False, layout="NCHW"):
     num_unit = len(units)
     assert num_unit == num_stages
+    ax = _bn_axis(layout)
     data = sym.Variable(name="data")
     if dtype == "float16" or dtype == "bfloat16":
         data = sym.Cast(data, dtype=dtype)
     data = sym.BatchNorm(data, fix_gamma=True, eps=2e-5, momentum=bn_mom,
-                         name="bn_data")
-    nchannel, height = filter_list[0], image_shape[1]
+                         axis=ax, name="bn_data")
+    height = image_shape[1] if layout == "NCHW" else image_shape[0]
     if height <= 32:  # cifar-style stem
         body = sym.Convolution(data, num_filter=filter_list[0],
                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                               no_bias=True, name="conv0")
+                               no_bias=True, layout=layout, name="conv0")
     else:  # imagenet stem
         body = sym.Convolution(data, num_filter=filter_list[0],
                                kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                               no_bias=True, name="conv0")
-        body = sym.BatchNorm(body, fix_gamma=False, eps=2e-5,
+                               no_bias=True, layout=layout, name="conv0")
+        body = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, axis=ax,
                              momentum=bn_mom, name="bn0")
         body = sym.Activation(body, act_type="relu", name="relu0")
         body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
-                           pool_type="max")
+                           pool_type="max", layout=layout)
 
     for i in range(num_stages):
         body = residual_unit(
@@ -91,17 +104,18 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
             (1 if i == 0 else 2, 1 if i == 0 else 2),
             False, name="stage%d_unit%d" % (i + 1, 1),
             bottle_neck=bottle_neck, bn_mom=bn_mom, workspace=workspace,
-            memonger=memonger)
+            memonger=memonger, layout=layout)
         for j in range(units[i] - 1):
             body = residual_unit(body, filter_list[i + 1], (1, 1), True,
                                  name="stage%d_unit%d" % (i + 1, j + 2),
                                  bottle_neck=bottle_neck, bn_mom=bn_mom,
-                                 workspace=workspace, memonger=memonger)
+                                 workspace=workspace, memonger=memonger,
+                                 layout=layout)
     bn1 = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=bn_mom,
-                        name="bn1")
+                        axis=ax, name="bn1")
     relu1 = sym.Activation(bn1, act_type="relu", name="relu1")
     pool1 = sym.Pooling(relu1, global_pool=True, kernel=(7, 7),
-                        pool_type="avg", name="pool1")
+                        pool_type="avg", layout=layout, name="pool1")
     flat = sym.Flatten(pool1)
     fc1 = sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
     if dtype in ("float16", "bfloat16"):
@@ -110,8 +124,12 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
 
 
 def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
-               conv_workspace=256, dtype="float32", **kwargs):
-    """Depth → units table exactly as the reference resnet.py."""
+               conv_workspace=256, dtype="float32", layout="NCHW", **kwargs):
+    """Depth → units table exactly as the reference resnet.py.
+
+    ``image_shape`` is always given channels-first (C, H, W) as in the
+    reference CLI; with ``layout="NHWC"`` the data variable is expected
+    as (N, H, W, C)."""
     if isinstance(image_shape, str):
         image_shape = tuple(int(x) for x in image_shape.split(","))
     height = image_shape[1]
@@ -146,7 +164,10 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
                              % num_layers)
         units = units_map[num_layers]
 
+    shape_for_stem = image_shape if layout == "NCHW" else \
+        (image_shape[1], image_shape[2], image_shape[0])
     return resnet(units=units, num_stages=num_stages,
                   filter_list=filter_list, num_classes=num_classes,
-                  image_shape=image_shape, bottle_neck=bottle_neck,
-                  workspace=conv_workspace, dtype=dtype, **kwargs)
+                  image_shape=shape_for_stem, bottle_neck=bottle_neck,
+                  workspace=conv_workspace, dtype=dtype, layout=layout,
+                  **kwargs)

@@ -103,11 +103,16 @@ def _conv_infer_shape(in_shapes, attrs):
         return in_shapes, [None], []
     nd = len(data_s) - 2
     kernel, stride, pad, dilate = _conv_geometry(attrs, nd)
-    c_in = data_s[1]
+    nhwc = attrs.get("layout") == "NHWC" and nd == 2
+    c_in = data_s[-1] if nhwc else data_s[1]
+    out_sp = tuple(_conv_out_dim(data_s[(1 if nhwc else 2) + i], kernel[i],
+                                 stride[i], pad[i], dilate[i])
+                   for i in range(nd))
+    # weight stays OIHW in BOTH layouts (initializers' fan-in/fan-out
+    # heuristics assume it; XLA's layout assignment transposes for free)
     w = (num_filter, c_in // num_group) + kernel
-    out_sp = tuple(_conv_out_dim(data_s[2 + i], kernel[i], stride[i], pad[i],
-                                 dilate[i]) for i in range(nd))
-    out = (data_s[0], num_filter) + out_sp
+    out = (data_s[0],) + out_sp + (num_filter,) if nhwc \
+        else (data_s[0], num_filter) + out_sp
     shapes = [data_s, w] + ([] if no_bias else [(num_filter,)])
     return shapes, [out], []
 
@@ -121,20 +126,27 @@ _CONV_DIMNUMS = {1: ("NCH", "OIH", "NCH"),
           aliases=["Convolution_v1"])
 def _convolution(ins, attrs, ctx):
     """N-d convolution (``src/operator/convolution-inl.h:490``); maps to one
-    ``lax.conv_general_dilated`` call → MXU."""
+    ``lax.conv_general_dilated`` call → MXU.  ``layout="NHWC"`` (the
+    reference ConvolutionParam layout option) keeps activations
+    channels-last; weights stay OIHW in both layouts so initializer
+    fan-in/fan-out heuristics and checkpoints are layout-independent —
+    XLA's layout assignment handles the physical transpose (PERF.md)."""
     x, w = ins[0], ins[1].astype(ins[0].dtype)  # bf16 policy: act dtype
     nd = x.ndim - 2
     kernel, stride, pad, dilate = _conv_geometry(attrs, nd)
     num_group = parse_int(attrs.get("num_group"), 1)
+    nhwc = attrs.get("layout") == "NHWC" and nd == 2
+    dimnums = ("NHWC", "OIHW", "NHWC") if nhwc else _CONV_DIMNUMS[nd]
     y = jax.lax.conv_general_dilated(
         x, w, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate,
-        dimension_numbers=_CONV_DIMNUMS[nd],
+        dimension_numbers=dimnums,
         feature_group_count=num_group)
     if len(ins) > 2:
-        b = ins[2].astype(y.dtype).reshape((1, -1) + (1,) * nd)
-        y = y + b
+        shape = (1,) * (1 + nd) + (-1,) if nhwc else \
+            (1, -1) + (1,) * nd
+        y = y + ins[2].astype(y.dtype).reshape(shape)
     return y
 
 
@@ -486,8 +498,23 @@ def _batch_norm(ins, attrs, ctx):
     b = beta.astype(jnp.float32).reshape(bshape)
 
     if ctx.is_train and not use_global:
-        mean = jnp.mean(x32, axis=red_axes)
-        var = jnp.var(x32, axis=red_axes)
+        # single-pass statistics: shifted sum and sum-of-squares fuse
+        # into ONE multi-output reduce reading the (bf16) activation once
+        # — jnp.var's mean-then-deviation form reads it twice and showed
+        # up as 27% of the ResNet-50 step in the xplane trace (PERF.md).
+        # The shift K = moving mean kills the E[x²]−E[x]² catastrophic
+        # cancellation when |mean| >> std: var = E[(x−K)²] − (E[x−K])²
+        # is exact for any K and the error term ∝ (mean−K)² vanishes as
+        # the moving mean converges.
+        red_n = float(np.prod([data.shape[i] for i in red_axes]))
+        shift = jax.lax.stop_gradient(
+            mov_mean.astype(jnp.float32)).reshape(bshape)
+        xs = x32 - shift
+        s = jnp.sum(xs, axis=red_axes)
+        s2 = jnp.sum(jnp.square(xs), axis=red_axes)
+        d = s / red_n
+        mean = d + shift.reshape(d.shape)
+        var = jnp.maximum(s2 / red_n - jnp.square(d), 0.0)
         out = (x32 - mean.reshape(bshape)) * jax.lax.rsqrt(
             var.reshape(bshape) + eps) * g + b
         new_mean = mov_mean * momentum + jax.lax.stop_gradient(mean) * (1 - momentum)
@@ -568,33 +595,43 @@ def _pool_infer_shape(in_shapes, attrs):
     if data_s is None:
         return in_shapes, [None], []
     nd = len(data_s) - 2
+    nhwc = attrs.get("layout") == "NHWC" and nd == 2
+    sp0 = 1 if nhwc else 2  # first spatial dim index
+
+    def out_shape(sp):
+        if nhwc:
+            return (data_s[0],) + tuple(sp) + (data_s[-1],)
+        return tuple(data_s[:2]) + tuple(sp)
+
     if parse_bool(attrs.get("global_pool", False)):
-        return [data_s], [tuple(data_s[:2]) + (1,) * nd], []
+        return [data_s], [out_shape((1,) * nd)], []
     kernel = parse_tuple(attrs.get("kernel"), nd)
     stride = parse_tuple(attrs.get("stride") or (1,) * nd, nd)
     pad = parse_tuple(attrs.get("pad") or (0,) * nd, nd)
     conv = attrs.get("pooling_convention", "valid")
     out_sp = []
     for i in range(nd):
-        num = data_s[2 + i] + 2 * pad[i] - kernel[i]
+        num = data_s[sp0 + i] + 2 * pad[i] - kernel[i]
         if conv == "full":
             o = int(np.ceil(num / stride[i])) + 1
         else:
             o = num // stride[i] + 1
         out_sp.append(o)
-    return [data_s], [tuple(data_s[:2]) + tuple(out_sp)], []
+    return [data_s], [out_shape(out_sp)], []
 
 
 @register("Pooling", arg_names=["data"], infer_shape=_pool_infer_shape,
           aliases=["Pooling_v1"])
 def _pooling(ins, attrs, ctx):
     """max/avg/sum pooling (``src/operator/pooling-inl.h``) via
-    ``lax.reduce_window``."""
+    ``lax.reduce_window``; ``layout="NHWC"`` pools channels-last."""
     x = ins[0]
     nd = x.ndim - 2
     ptype = attrs.get("pool_type", "max")
+    nhwc = attrs.get("layout") == "NHWC" and nd == 2
+    sp0 = 1 if nhwc else 2
     if parse_bool(attrs.get("global_pool", False)):
-        red = tuple(range(2, x.ndim))
+        red = tuple(range(sp0, sp0 + nd))
         if ptype == "max":
             return jnp.max(x, axis=red, keepdims=True)
         if ptype == "sum":
@@ -605,16 +642,22 @@ def _pooling(ins, attrs, ctx):
     # output size per convention; 'full' (ceil) needs extra right padding
     extra = [0] * nd
     for i in range(nd):
-        num = x.shape[2 + i] + 2 * pad[i] - kernel[i]
+        num = x.shape[sp0 + i] + 2 * pad[i] - kernel[i]
         if conv == "full":
             o = int(np.ceil(num / stride[i])) + 1
         else:
             o = num // stride[i] + 1
         extra[i] = max(0, (o - 1) * stride[i] + kernel[i]
-                       - (x.shape[2 + i] + 2 * pad[i]))
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    pads = [(0, 0), (0, 0)] + [(pad[i], pad[i] + extra[i]) for i in range(nd)]
+                       - (x.shape[sp0 + i] + 2 * pad[i]))
+    sp_pads = [(pad[i], pad[i] + extra[i]) for i in range(nd)]
+    if nhwc:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = [(0, 0)] + sp_pads + [(0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = [(0, 0), (0, 0)] + sp_pads
     if ptype == "max":
         init = -jnp.inf
         y = jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)

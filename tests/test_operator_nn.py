@@ -109,6 +109,81 @@ def test_convolution():
     assert shapes[1] == (3, 2, 3, 3) and outs[0] == (1, 3, 3, 3)
 
 
+def test_convolution_nhwc():
+    """layout="NHWC" (reference ConvolutionParam layout option) matches
+    the NCHW path on transposed data; weights stay OIHW in both layouts
+    (initializer fan heuristics and checkpoints are layout-independent)."""
+    rng = np.random.RandomState(30)
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    attrs = {"kernel": "(3, 3)", "num_filter": "4", "stride": "(2, 2)",
+             "pad": "(1, 1)"}
+    want = apply_op("Convolution", [x, w, b], attrs)[0]
+    x_l = np.transpose(x, (0, 2, 3, 1))
+    out = apply_op("Convolution", [x_l, w, b],
+                   dict(attrs, layout="NHWC"))[0]
+    np.testing.assert_allclose(np.transpose(out, (0, 3, 1, 2)), want,
+                               rtol=1e-4, atol=1e-4)
+    op = get_op("Convolution")
+    shapes, outs, _ = op.infer_shape([(2, 6, 6, 3), None, None],
+                                     dict(attrs, layout="NHWC"))
+    assert shapes[1] == (4, 3, 3, 3) and outs[0] == (2, 3, 3, 4)
+
+
+def test_pooling_nhwc():
+    rng = np.random.RandomState(31)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    x_l = np.transpose(x, (0, 2, 3, 1))
+    for ptype in ("max", "avg"):
+        attrs = {"kernel": "(3, 3)", "stride": "(2, 2)", "pad": "(1, 1)",
+                 "pool_type": ptype}
+        want = apply_op("Pooling", [x], attrs)[0]
+        out = apply_op("Pooling", [x_l], dict(attrs, layout="NHWC"))[0]
+        np.testing.assert_allclose(np.transpose(out, (0, 3, 1, 2)), want,
+                                   rtol=1e-5, atol=1e-5)
+    want = apply_op("Pooling", [x], {"global_pool": "1"})[0]
+    out = apply_op("Pooling", [x_l], {"global_pool": "1",
+                                      "layout": "NHWC"})[0]
+    np.testing.assert_allclose(np.transpose(out, (0, 3, 1, 2)), want,
+                               rtol=1e-5)
+    op = get_op("Pooling")
+    _, outs, _ = op.infer_shape([(1, 5, 5, 2)],
+                                {"kernel": "(3, 3)", "stride": "(2, 2)",
+                                 "layout": "NHWC"})
+    assert outs[0] == (1, 2, 2, 2)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """models.resnet(layout="NHWC") is numerically the NCHW net on
+    transposed data."""
+    import incubator_mxnet_tpu as mx
+    rng = np.random.RandomState(32)
+    kw = dict(num_layers=18, num_classes=10, image_shape=(3, 32, 32))
+    net_c = mx.models.resnet(**kw)
+    net_l = mx.models.resnet(layout="NHWC", **kw)
+    x = rng.randn(2, 3, 32, 32).astype(np.float32)
+    shapes_c = {"data": (2, 3, 32, 32), "softmax_label": (2,)}
+    shapes_l = {"data": (2, 32, 32, 3), "softmax_label": (2,)}
+    ex_c = net_c.simple_bind(grad_req="null", **shapes_c)
+    ex_l = net_l.simple_bind(grad_req="null", **shapes_l)
+    rngp = np.random.RandomState(33)
+    for n in sorted(ex_c.arg_dict):
+        if n in shapes_c:
+            continue
+        v = rngp.uniform(-0.1, 0.1,
+                         ex_c.arg_dict[n].shape).astype(np.float32)
+        ex_c.arg_dict[n][:] = mx.nd.array(v)
+        # weights are OIHW in BOTH layouts — same arrays load directly
+        assert ex_l.arg_dict[n].shape == v.shape, (n, v.shape)
+        ex_l.arg_dict[n][:] = mx.nd.array(v)
+    ex_c.arg_dict["data"][:] = mx.nd.array(x)
+    ex_l.arg_dict["data"][:] = mx.nd.array(np.transpose(x, (0, 2, 3, 1)))
+    out_c = ex_c.forward(is_train=False)[0].asnumpy()
+    out_l = ex_l.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out_l, out_c, rtol=1e-4, atol=1e-5)
+
+
 def _np_deconv2d(x, w, stride, pad, kernel, adj=(0, 0)):
     n, cin, h, wd = x.shape
     _, cout, kh, kw = w.shape
