@@ -1,0 +1,28 @@
+"""Zoo-internal container layers
+(``python/mxnet/gluon/model_zoo/custom_layers.py``)."""
+from __future__ import annotations
+
+from ..nn.basic_layers import HybridSequential
+from ..block import HybridBlock
+
+__all__ = ["HybridConcurrent", "Identity"]
+
+
+class HybridConcurrent(HybridSequential):
+    """Run each child on the same input and concatenate the outputs along
+    ``concat_dim`` (reference ``custom_layers.py:HybridConcurrent``)."""
+
+    def __init__(self, concat_dim=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.concat_dim = concat_dim
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children]
+        return F.concat(*out, dim=self.concat_dim)
+
+
+class Identity(HybridBlock):
+    """Pass-through block (reference ``custom_layers.py:Identity``)."""
+
+    def hybrid_forward(self, F, x):
+        return x

@@ -11,13 +11,15 @@ import os
 from ...base import MXNetError
 from .. import nn
 from ..block import HybridBlock
+from .custom_layers import HybridConcurrent
 
 __all__ = ["get_model", "resnet18_v1", "resnet34_v1", "resnet50_v1",
            "resnet101_v1", "resnet152_v1", "resnet18_v2", "resnet34_v2",
            "resnet50_v2", "resnet101_v2", "resnet152_v2", "vgg11", "vgg13",
            "vgg16", "vgg19", "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn",
            "alexnet", "squeezenet1_0", "squeezenet1_1", "densenet121",
-           "densenet161", "densenet169", "densenet201", "mlp_model"]
+           "densenet161", "densenet169", "densenet201", "inception_v3",
+           "mlp_model"]
 
 
 def _maybe_load(net, name, pretrained, root, ctx):
@@ -534,6 +536,152 @@ def densenet201(**kw):
     return _densenet(201, **kw)
 
 
+# ---------------------------------------------------------------------------
+# Inception v3 (``python/mxnet/gluon/model_zoo/vision/inception.py``)
+# ---------------------------------------------------------------------------
+
+
+def _inc_conv(**kwargs):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.Conv2D(use_bias=False, **kwargs))
+    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+def _inc_branch(use_pool, *conv_settings):
+    out = nn.HybridSequential(prefix="")
+    if use_pool == "avg":
+        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+    elif use_pool == "max":
+        out.add(nn.MaxPool2D(pool_size=3, strides=2))
+    names = ["channels", "kernel_size", "strides", "padding"]
+    for setting in conv_settings:
+        kw = {names[i]: v for i, v in enumerate(setting) if v is not None}
+        out.add(_inc_conv(**kw))
+    return out
+
+
+def _inc_A(pool_features, prefix):
+    out = HybridConcurrent(concat_dim=1, prefix=prefix)
+    with out.name_scope():
+        out.add(_inc_branch(None, (64, 1, None, None)))
+        out.add(_inc_branch(None, (48, 1, None, None), (64, 5, None, 2)))
+        out.add(_inc_branch(None, (64, 1, None, None), (96, 3, None, 1),
+                            (96, 3, None, 1)))
+        out.add(_inc_branch("avg", (pool_features, 1, None, None)))
+    return out
+
+
+def _inc_B(prefix):
+    out = HybridConcurrent(concat_dim=1, prefix=prefix)
+    with out.name_scope():
+        out.add(_inc_branch(None, (384, 3, 2, None)))
+        out.add(_inc_branch(None, (64, 1, None, None), (96, 3, None, 1),
+                            (96, 3, 2, None)))
+        out.add(_inc_branch("max"))
+    return out
+
+
+def _inc_C(channels_7x7, prefix):
+    out = HybridConcurrent(concat_dim=1, prefix=prefix)
+    with out.name_scope():
+        out.add(_inc_branch(None, (192, 1, None, None)))
+        out.add(_inc_branch(None, (channels_7x7, 1, None, None),
+                            (channels_7x7, (1, 7), None, (0, 3)),
+                            (192, (7, 1), None, (3, 0))))
+        out.add(_inc_branch(None, (channels_7x7, 1, None, None),
+                            (channels_7x7, (7, 1), None, (3, 0)),
+                            (channels_7x7, (1, 7), None, (0, 3)),
+                            (channels_7x7, (7, 1), None, (3, 0)),
+                            (192, (1, 7), None, (0, 3))))
+        out.add(_inc_branch("avg", (192, 1, None, None)))
+    return out
+
+
+def _inc_D(prefix):
+    out = HybridConcurrent(concat_dim=1, prefix=prefix)
+    with out.name_scope():
+        out.add(_inc_branch(None, (192, 1, None, None),
+                            (320, 3, 2, None)))
+        out.add(_inc_branch(None, (192, 1, None, None),
+                            (192, (1, 7), None, (0, 3)),
+                            (192, (7, 1), None, (3, 0)),
+                            (192, 3, 2, None)))
+        out.add(_inc_branch("max"))
+    return out
+
+
+def _inc_E(prefix):
+    out = HybridConcurrent(concat_dim=1, prefix=prefix)
+    with out.name_scope():
+        out.add(_inc_branch(None, (320, 1, None, None)))
+
+        branch_3x3 = nn.HybridSequential(prefix="")
+        out.add(branch_3x3)
+        branch_3x3.add(_inc_branch(None, (384, 1, None, None)))
+        split_3x3 = HybridConcurrent(concat_dim=1, prefix="")
+        split_3x3.add(_inc_branch(None, (384, (1, 3), None, (0, 1))))
+        split_3x3.add(_inc_branch(None, (384, (3, 1), None, (1, 0))))
+        branch_3x3.add(split_3x3)
+
+        branch_dbl = nn.HybridSequential(prefix="")
+        out.add(branch_dbl)
+        branch_dbl.add(_inc_branch(None, (448, 1, None, None),
+                                   (384, 3, None, 1)))
+        split_dbl = HybridConcurrent(concat_dim=1, prefix="")
+        branch_dbl.add(split_dbl)
+        split_dbl.add(_inc_branch(None, (384, (1, 3), None, (0, 1))))
+        split_dbl.add(_inc_branch(None, (384, (3, 1), None, (1, 0))))
+
+        out.add(_inc_branch("avg", (192, 1, None, None)))
+    return out
+
+
+class Inception3(HybridBlock):
+    """Inception v3 (reference ``inception.py:Inception3``; input 299²)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(_inc_conv(channels=32, kernel_size=3,
+                                        strides=2))
+            self.features.add(_inc_conv(channels=32, kernel_size=3))
+            self.features.add(_inc_conv(channels=64, kernel_size=3,
+                                        padding=1))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+            self.features.add(_inc_conv(channels=80, kernel_size=1))
+            self.features.add(_inc_conv(channels=192, kernel_size=3))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+            self.features.add(_inc_A(32, "A1_"))
+            self.features.add(_inc_A(64, "A2_"))
+            self.features.add(_inc_A(64, "A3_"))
+            self.features.add(_inc_B("B_"))
+            self.features.add(_inc_C(128, "C1_"))
+            self.features.add(_inc_C(160, "C2_"))
+            self.features.add(_inc_C(160, "C3_"))
+            self.features.add(_inc_C(192, "C4_"))
+
+            self.classifier = nn.HybridSequential(prefix="")
+            self.classifier.add(_inc_D("D_"))
+            self.classifier.add(_inc_E("E1_"))
+            self.classifier.add(_inc_E("E2_"))
+            self.classifier.add(nn.AvgPool2D(pool_size=8))
+            self.classifier.add(nn.Dropout(0.5))
+            self.classifier.add(nn.Flatten())
+            self.classifier.add(nn.Dense(classes))
+
+    def hybrid_forward(self, F, x):
+        return self.classifier(self.features(x))
+
+
+def inception_v3(pretrained=False, ctx=None, root="~/.mxnet/models",
+                 **kwargs):
+    net = Inception3(**kwargs)
+    return _maybe_load(net, "inceptionv3", pretrained, root, ctx)
+
+
 def mlp_model(classes=10, **kwargs):
     net = nn.HybridSequential(**kwargs)
     net.add(nn.Dense(128, activation="relu"),
@@ -553,6 +701,7 @@ _MODELS = {
     "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
     "densenet121": densenet121, "densenet161": densenet161,
     "densenet169": densenet169, "densenet201": densenet201,
+    "inceptionv3": inception_v3,
 }
 
 

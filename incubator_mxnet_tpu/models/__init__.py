@@ -8,7 +8,7 @@ exactly like the reference train scripts expect.
 from .lenet import get_symbol as lenet
 from .mlp import get_symbol as mlp
 from .alexnet import get_symbol as alexnet
-from .resnet import get_symbol as resnet
+from .resnet import get_symbol as resnet, image_data_shape
 from .vgg import get_symbol as vgg
 from .inception_bn import get_symbol as inception_bn
 from .lstm_ptb import get_symbol as lstm_ptb, lstm_ptb_sym_gen
@@ -19,14 +19,6 @@ __all__ = ["lenet", "mlp", "alexnet", "resnet", "vgg", "inception_bn",
            "lstm_ptb", "lstm_ptb_sym_gen", "ssd_300", "ssd_train",
            "ssd_deploy", "get_symbol", "image_data_shape"]
 
-
-def image_data_shape(image_shape, layout="NCHW"):
-    """The data-variable shape (sans batch) for a CLI-style channels-first
-    ``image_shape`` under the given layout — single source of the
-    CHW→HWC convention used by ``resnet(layout="NHWC")`` and bench."""
-    if layout == "NHWC":
-        return (image_shape[1], image_shape[2], image_shape[0])
-    return tuple(image_shape)
 
 _ZOO = {"lenet": lenet, "mlp": mlp, "alexnet": alexnet, "resnet": resnet,
         "vgg": vgg, "inception-bn": inception_bn,

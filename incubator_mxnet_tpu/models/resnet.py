@@ -12,6 +12,17 @@ softmax), matching how the reference used fp16
 from .. import symbol as sym
 
 
+def image_data_shape(image_shape, layout="NCHW"):
+    """The data-variable shape (sans batch) for a CLI-style channels-first
+    ``image_shape`` under the given layout — single source of the
+    CHW→HWC convention used by ``resnet(layout="NHWC")`` and bench."""
+    if layout == "NHWC":
+        return (image_shape[1], image_shape[2], image_shape[0])
+    if layout != "NCHW":
+        raise ValueError("unsupported layout %r (NCHW or NHWC)" % (layout,))
+    return tuple(image_shape)
+
+
 def _bn_axis(layout):
     return 3 if layout == "NHWC" else 1
 
@@ -164,8 +175,7 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
                              % num_layers)
         units = units_map[num_layers]
 
-    shape_for_stem = image_shape if layout == "NCHW" else \
-        (image_shape[1], image_shape[2], image_shape[0])
+    shape_for_stem = image_data_shape(image_shape, layout)
     return resnet(units=units, num_stages=num_stages,
                   filter_list=filter_list, num_classes=num_classes,
                   image_shape=shape_for_stem, bottle_neck=bottle_neck,

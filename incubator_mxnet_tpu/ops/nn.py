@@ -32,6 +32,27 @@ from .registry import (register, parse_tuple, parse_bool, parse_int,
 __all__ = []
 
 
+# channels-first layouts per spatial rank (reference ConvolutionParam
+# layout enum, src/operator/convolution-inl.h; the cuDNN-only NHWC/NDHWC
+# variants beyond 2-D NHWC are not lowered — raise instead of silently
+# misreading channels-last data as channels-first)
+_CF_LAYOUTS = {1: ("NCW",), 2: ("NCHW",), 3: ("NCDHW",)}
+
+
+def _layout_is_nhwc(attrs, nd):
+    layout = attrs.get("layout")
+    if layout in (None, "", "None"):
+        return False
+    if layout == "NHWC" and nd == 2:
+        return True
+    if layout in _CF_LAYOUTS.get(nd, ()):
+        return False
+    raise ValueError(
+        "unsupported layout %r for %d-d spatial data (supported: %s%s)"
+        % (layout, nd, "/".join(_CF_LAYOUTS.get(nd, ())),
+           ", NHWC" if nd == 2 else ""))
+
+
 # ---------------------------------------------------------------------------
 # FullyConnected
 # ---------------------------------------------------------------------------
@@ -103,7 +124,7 @@ def _conv_infer_shape(in_shapes, attrs):
         return in_shapes, [None], []
     nd = len(data_s) - 2
     kernel, stride, pad, dilate = _conv_geometry(attrs, nd)
-    nhwc = attrs.get("layout") == "NHWC" and nd == 2
+    nhwc = _layout_is_nhwc(attrs, nd)
     c_in = data_s[-1] if nhwc else data_s[1]
     out_sp = tuple(_conv_out_dim(data_s[(1 if nhwc else 2) + i], kernel[i],
                                  stride[i], pad[i], dilate[i])
@@ -135,7 +156,7 @@ def _convolution(ins, attrs, ctx):
     nd = x.ndim - 2
     kernel, stride, pad, dilate = _conv_geometry(attrs, nd)
     num_group = parse_int(attrs.get("num_group"), 1)
-    nhwc = attrs.get("layout") == "NHWC" and nd == 2
+    nhwc = _layout_is_nhwc(attrs, nd)
     dimnums = ("NHWC", "OIHW", "NHWC") if nhwc else _CONV_DIMNUMS[nd]
     y = jax.lax.conv_general_dilated(
         x, w, window_strides=stride,
@@ -595,7 +616,7 @@ def _pool_infer_shape(in_shapes, attrs):
     if data_s is None:
         return in_shapes, [None], []
     nd = len(data_s) - 2
-    nhwc = attrs.get("layout") == "NHWC" and nd == 2
+    nhwc = _layout_is_nhwc(attrs, nd)
     sp0 = 1 if nhwc else 2  # first spatial dim index
 
     def out_shape(sp):
@@ -628,7 +649,7 @@ def _pooling(ins, attrs, ctx):
     x = ins[0]
     nd = x.ndim - 2
     ptype = attrs.get("pool_type", "max")
-    nhwc = attrs.get("layout") == "NHWC" and nd == 2
+    nhwc = _layout_is_nhwc(attrs, nd)
     sp0 = 1 if nhwc else 2
     if parse_bool(attrs.get("global_pool", False)):
         red = tuple(range(sp0, sp0 + nd))

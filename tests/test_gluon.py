@@ -215,3 +215,18 @@ def test_model_zoo_construct():
         net.initialize(mx.init.Xavier())
         out = net(mx.nd.ones((1, 3, 224, 224)))
         assert out.shape == (1, 10), name
+
+
+def test_model_zoo_densenet():
+    net = gluon.model_zoo.get_model("densenet121", classes=10)
+    net.initialize(mx.init.Xavier())
+    out = net(mx.nd.ones((1, 3, 224, 224)))
+    assert out.shape == (1, 10)
+
+
+def test_model_zoo_inception_v3():
+    # reference inception.py:Inception3 — 299x299 input
+    net = gluon.model_zoo.get_model("inceptionv3", classes=10)
+    net.initialize(mx.init.Xavier())
+    out = net(mx.nd.ones((1, 3, 299, 299)))
+    assert out.shape == (1, 10)
