@@ -48,11 +48,34 @@ class DataLoader:
                              "conflict with batch_sampler")
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
+        if num_workers < 0:
+            raise MXNetError("num_workers must be >= 0")
+        self._num_workers = num_workers
+
+    def _fetch(self, batch):
+        return self._batchify_fn([self._dataset[int(i)] for i in batch])
 
     def __iter__(self):
-        for batch in self._batch_sampler:
-            yield self._batchify_fn([self._dataset[int(i)]
-                                     for i in batch])
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._fetch(batch)
+            return
+        # one batch per worker task, up to 2*num_workers batches in flight,
+        # yielded in sampler order (thread-based: TPU hosts feed the device
+        # from host RAM, so decode/augment in __getitem__ releases the GIL
+        # in numpy/PIL and threads suffice — the role of the reference's
+        # later multiprocessing workers)
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(self._num_workers) as pool:
+            pending = deque()
+            for batch in self._batch_sampler:
+                pending.append(pool.submit(self._fetch, batch))
+                if len(pending) >= 2 * self._num_workers:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
 
     def __len__(self):
         return len(self._batch_sampler)

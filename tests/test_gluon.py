@@ -153,6 +153,44 @@ def test_dataset_dataloader():
     assert len(list(loader2)) == 3
 
 
+def test_dataloader_workers_match_serial():
+    x = np.arange(60, dtype=np.float32).reshape(30, 2)
+    y = np.arange(30, dtype=np.float32)
+    ds = gluon.data.ArrayDataset(x, y)
+    serial = [(d.asnumpy(), l.asnumpy()) for d, l in
+              gluon.data.DataLoader(ds, batch_size=4, last_batch="keep")]
+    threaded = [(d.asnumpy(), l.asnumpy()) for d, l in
+                gluon.data.DataLoader(ds, batch_size=4, last_batch="keep",
+                                      num_workers=3)]
+    assert len(serial) == len(threaded)
+    for (d0, l0), (d1, l1) in zip(serial, threaded):
+        np.testing.assert_array_equal(d0, d1)
+        np.testing.assert_array_equal(l0, l1)
+
+
+def test_dataloader_workers_overlap():
+    import time
+
+    class SlowDataset(gluon.data.Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, idx):
+            time.sleep(0.01)
+            return np.float32(idx)
+
+    ds = SlowDataset()
+    t0 = time.time()
+    n0 = len(list(gluon.data.DataLoader(ds, batch_size=8, num_workers=0)))
+    serial_t = time.time() - t0
+    t0 = time.time()
+    n4 = len(list(gluon.data.DataLoader(ds, batch_size=8, num_workers=4)))
+    worker_t = time.time() - t0
+    assert n0 == n4 == 4
+    # 4 batches fetched by 4 workers concurrently; generous margin for CI
+    assert worker_t < serial_t * 0.75, (serial_t, worker_t)
+
+
 def test_vision_dataset_synthetic():
     ds = gluon.data.vision.MNIST(root="/nonexistent_mnist")
     img, label = ds[0]

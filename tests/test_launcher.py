@@ -1,0 +1,156 @@
+"""ssh/mpi launcher command construction (reference ``tools/launch.py:29-79``
+dispatching to dmlc-tracker ssh/mpi trackers) — no real ssh/mpirun is run."""
+import importlib.util
+import os
+import shlex
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "tp_launch", os.path.join(REPO, "tools", "launch.py"))
+launch = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(launch)
+
+
+BASE_ENV = {
+    "DMLC_NUM_WORKER": "4", "DMLC_NUM_SERVER": "2",
+    "DMLC_PS_ROOT_URI": "10.0.0.1", "DMLC_PS_ROOT_PORT": "9091",
+    "KVSTORE_COORDINATOR": "10.0.0.1", "JAX_COORD_PORT": "9092",
+    "PATH": "/usr/bin",          # must NOT be forwarded
+    "HOME": "/root",             # must NOT be forwarded
+    "MXNET_ENGINE_TYPE": "NaiveEngine",  # MXNET_* is forwarded
+}
+
+
+def test_read_hostfile(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("# comment\nhost1\nhost2:3\n\nhost3 # inline\n")
+    assert launch.read_hostfile(str(hf)) == [
+        ("host1", 1), ("host2", 3), ("host3", 1)]
+
+
+def test_read_hostfile_empty(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("# nothing\n")
+    with pytest.raises(ValueError):
+        launch.read_hostfile(str(hf))
+
+
+def test_plan_ssh_jobs_round_robin():
+    hosts = [("h1", 1), ("h2", 1)]
+    jobs = launch.plan_ssh_jobs(4, 2, hosts, BASE_ENV,
+                                ["python", "train.py"], workdir="/job")
+    roles = [(r, h) for r, h, _ in jobs]
+    # servers first, then workers, round-robin over hosts
+    assert roles == [("server", "h1"), ("server", "h2"),
+                     ("worker", "h1"), ("worker", "h2"),
+                     ("worker", "h1"), ("worker", "h2")]
+
+
+def test_ssh_command_contents():
+    hosts = [("gpu-a", 1)]
+    jobs = launch.plan_ssh_jobs(1, 1, hosts, BASE_ENV,
+                                ["python", "train.py", "--lr", "0.1"],
+                                workdir="/job dir")
+    for role, host, argv in jobs:
+        assert argv[0] == "ssh"
+        assert "StrictHostKeyChecking=no" in argv
+        assert argv[-2] == host
+        remote = argv[-1]
+        # rendezvous env exported, role assigned, local-only env dropped
+        assert "export DMLC_PS_ROOT_URI=10.0.0.1" in remote
+        assert "export DMLC_PS_ROOT_PORT=9091" in remote
+        assert "export DMLC_ROLE=%s" % role in remote
+        assert "export MXNET_ENGINE_TYPE=NaiveEngine" in remote
+        assert "PATH=" not in remote and "HOME=" not in remote
+        # cd into the (quoted) workdir before the command
+        assert "cd %s" % shlex.quote("/job dir") in remote
+        assert remote.endswith("python train.py --lr 0.1")
+    srv_remote = jobs[0][2][-1]
+    wrk_remote = jobs[1][2][-1]
+    assert "export TP_SERVER_ID=0" in srv_remote
+    assert "export DMLC_WORKER_ID=0" in wrk_remote
+
+
+def test_ssh_quoting():
+    env = dict(BASE_ENV)
+    env["DMLC_EXTRA"] = "a b;rm -rf /"
+    argv = launch.build_ssh_command("h", {"DMLC_EXTRA": env["DMLC_EXTRA"]},
+                                    ["echo", "x y"])
+    remote = argv[-1]
+    assert shlex.quote("a b;rm -rf /") in remote
+    assert remote.endswith(shlex.quote("x y"))
+
+
+def test_sync_command():
+    argv = launch.build_sync_command("h2", "/src/dir/", "/dst")
+    assert argv == ["rsync", "-az", "--delete", "/src/dir/", "h2:/dst"]
+
+
+def test_parse_log(tmp_path):
+    _spec2 = importlib.util.spec_from_file_location(
+        "tp_parse_log", os.path.join(REPO, "tools", "parse_log.py"))
+    parse_log = importlib.util.module_from_spec(_spec2)
+    _spec2.loader.exec_module(parse_log)
+    lines = [
+        "INFO:root:Epoch[0] Train-accuracy=0.50\n",
+        "INFO:root:Epoch[0] Validation-accuracy=0.40\n",
+        "INFO:root:Epoch[0] Time cost=10.0\n",
+        "INFO:root:Epoch[1] Train-accuracy=0.80\n",
+        "INFO:root:Epoch[1] Train-top_k_accuracy=0.90\n",
+        "INFO:root:Epoch[1] Validation-accuracy=0.70\n",
+        "INFO:root:Epoch[1] Time cost=12.0\n",
+        "noise line\n",
+    ]
+    data = parse_log.parse(lines)
+    assert sorted(data) == [0, 1]
+    md = parse_log.render(data)
+    assert md.splitlines()[0].startswith("| epoch |")
+    # epoch 1 train is the average of the two Train- metrics
+    assert "| %2d | %f | %f | %.1f |" % (2, 0.85, 0.70, 12.0) in md
+    tsv = parse_log.render(data, "none")
+    assert tsv.splitlines()[1].startswith(" 1\t")
+
+
+def test_mpi_commands():
+    cmds = launch.build_mpi_commands(4, 2, "hosts.txt", BASE_ENV,
+                                     ["python", "train.py"])
+    assert [r for r, _ in cmds] == ["server", "worker"]
+    srv, wrk = cmds[0][1], cmds[1][1]
+    assert srv[:1] == ["mpirun"] and wrk[:1] == ["mpirun"]
+    assert srv[srv.index("-np") + 1] == "2"
+    assert wrk[wrk.index("-np") + 1] == "4"
+    for cmd, role in ((srv, "server"), (wrk, "worker")):
+        assert cmd[cmd.index("--hostfile") + 1] == "hosts.txt"
+        assert "DMLC_ROLE=%s" % role in cmd
+        assert cmd[cmd.index("DMLC_ROLE=%s" % role) - 1] == "-x"
+        assert cmd[-2:] == ["python", "train.py"]
+        assert not any(a.startswith("PATH=") for a in cmd)
+    # per-rank ids come from a sh shim reading the MPI rank env: a single
+    # mpirun env would otherwise give every rank DMLC_WORKER_ID=0
+    assert "OMPI_COMM_WORLD_RANK" in wrk[wrk.index("-c") + 1]
+    assert "DMLC_WORKER_ID" in wrk[wrk.index("-c") + 1]
+    assert "TP_SERVER_ID" in srv[srv.index("-c") + 1]
+    assert not any(a.startswith("DMLC_WORKER_ID=") for a in wrk)
+
+
+def test_worker0_host():
+    hosts = [("h1", 1), ("h2", 1), ("h3", 1)]
+    # collective mode: worker 0 lands on the first host
+    assert launch.worker0_host(4, 0, hosts) == "h1"
+    # PS mode: servers take h1/h2 first, worker 0 lands on h3
+    assert launch.worker0_host(4, 2, hosts) == "h3"
+
+
+def test_user_env_forwarded():
+    env = dict(BASE_ENV)
+    env["OMP_NUM_THREADS"] = "4"
+    jobs = launch.plan_ssh_jobs(1, 0, [("h", 1)], env,
+                                ["python", "t.py"],
+                                pass_keys=("OMP_NUM_THREADS",))
+    remote = jobs[0][2][-1]
+    assert "export OMP_NUM_THREADS=4" in remote
+    cmds = launch.build_mpi_commands(2, 0, None, env, ["python", "t.py"],
+                                     pass_keys=("OMP_NUM_THREADS",))
+    assert "OMP_NUM_THREADS=4" in cmds[0][1]

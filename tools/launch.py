@@ -1,29 +1,45 @@
 #!/usr/bin/env python
-"""Local cluster launcher (``/root/reference/tools/launch.py:29-79`` via
-dmlc-tracker's local launcher).
+"""Cluster launcher (``/root/reference/tools/launch.py:29-79`` via
+dmlc-tracker's local/ssh/mpi launchers).
 
-Spawns scheduler + server + worker processes on this machine with env-var
-rendezvous:
+Modes:
 
-- PS roles (``-s N``): ``DMLC_ROLE`` ∈ {scheduler, server, worker};
-  importing the framework in a server/scheduler process parks it in the
-  serving loop (``kvstore_server.init_server_module``);
-- collective workers additionally get a jax.distributed coordinator
-  (worker 0) so ``dist_sync`` kvstores psum over DCN.
+- ``--launcher local`` (default): spawn scheduler + server + worker
+  processes on this machine with env-var rendezvous;
+- ``--launcher ssh -H hostfile``: run the scheduler locally and the
+  server/worker processes on the hosts listed in ``hostfile``
+  (round-robin), each via ``ssh host 'export ...; cd dir; cmd'`` exactly
+  like the dmlc ssh tracker; ``--sync-dst-dir`` rsyncs the working
+  directory to every host first;
+- ``--launcher mpi -H hostfile``: one ``mpirun`` per role group with the
+  rendezvous env forwarded via ``-x`` (OpenMPI convention).
+
+Role contract in every mode: ``DMLC_ROLE`` ∈ {scheduler, server, worker};
+importing the framework in a server/scheduler process parks it in the
+serving loop (``kvstore_server.init_server_module``); collective workers
+additionally get a jax.distributed coordinator (worker 0) so ``dist_sync``
+kvstores psum over DCN.
 
 Example (the nightly contract, ``tests/nightly/test_all.sh:55``)::
 
     python tools/launch.py -n 4 python dist_sync_kvstore.py
     python tools/launch.py -n 4 -s 2 python async_training.py
+    python tools/launch.py -n 8 -s 4 --launcher ssh -H hosts train.py
 """
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import signal
 import socket
 import subprocess
 import sys
+
+# rendezvous env propagated to every remote node (the dmlc ssh tracker
+# whitelist: it exports DMLC_* plus the tracker address)
+_PASS_ENV_PREFIXES = ("DMLC_", "TP_", "MXNET_")
+_PASS_ENV_KEYS = ("KVSTORE_COORDINATOR", "JAX_COORD_PORT")
 
 
 def _free_port() -> int:
@@ -32,19 +48,273 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _local_ip() -> str:
+    """The address remote nodes can reach the launching host on."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+def read_hostfile(path):
+    """One host per line (optionally ``host:slots``), '#' comments."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            host, _, slots = line.partition(":")
+            hosts.append((host, int(slots) if slots else 1))
+    if not hosts:
+        raise ValueError("hostfile %s lists no hosts" % path)
+    return hosts
+
+
+def _expand_slots(hosts):
+    out = []
+    for host, slots in hosts:
+        out.extend([host] * slots)
+    return out
+
+
+def _remote_env(base_env, role, extra, pass_keys=()):
+    env = {k: v for k, v in base_env.items()
+           if k.startswith(_PASS_ENV_PREFIXES) or k in _PASS_ENV_KEYS
+           or k in pass_keys}
+    env["DMLC_ROLE"] = role
+    env.update(extra)
+    return env
+
+
+def build_ssh_command(host, env, command, workdir=None, ssh_opts=()):
+    """One dmlc-ssh-tracker-style remote spawn:
+    ``ssh -o StrictHostKeyChecking=no host 'export K=V; cd dir; cmd'``."""
+    exports = "; ".join("export %s=%s" % (k, shlex.quote(str(v)))
+                        for k, v in sorted(env.items()))
+    remote = exports
+    if workdir:
+        remote += "; cd %s" % shlex.quote(workdir)
+    remote += "; " + " ".join(shlex.quote(c) for c in command)
+    return ["ssh", "-o", "StrictHostKeyChecking=no",
+            *ssh_opts, host, remote]
+
+
+def build_sync_command(host, src_dir, dst_dir):
+    """``rsync -az src/ host:dst`` (the tracker's --sync-dst-dir)."""
+    return ["rsync", "-az", "--delete",
+            src_dir.rstrip("/") + "/",
+            "%s:%s" % (host, dst_dir)]
+
+
+def worker0_host(num_workers, num_servers, hosts):
+    """The host rank-0 worker lands on under the round-robin plan — the
+    collective (jax.distributed) coordinator must run THERE, not on the
+    launching machine (which only hosts the PS scheduler)."""
+    slots = _expand_slots(hosts)
+    return slots[num_servers % len(slots)]
+
+
+def plan_ssh_jobs(num_workers, num_servers, hosts, base_env, command,
+                  workdir=None, pass_keys=()):
+    """Assign roles to hosts round-robin (dmlc ssh tracker order: servers
+    first, then workers) and build every remote command.  Pure — no ssh is
+    run — so the plan is unit-testable."""
+    slots = _expand_slots(hosts)
+    jobs = []  # (role, host, argv)
+    for i in range(num_servers):
+        host = slots[i % len(slots)]
+        env = _remote_env(base_env, "server", {"TP_SERVER_ID": str(i)},
+                          pass_keys)
+        jobs.append(("server", host,
+                     build_ssh_command(host, env, command, workdir)))
+    for r in range(num_workers):
+        host = slots[(num_servers + r) % len(slots)]
+        env = _remote_env(base_env, "worker", {"DMLC_WORKER_ID": str(r)},
+                          pass_keys)
+        jobs.append(("worker", host,
+                     build_ssh_command(host, env, command, workdir)))
+    return jobs
+
+
+# mpirun forwards ONE env to all ranks, so per-rank ids must come from the
+# MPI rank itself: a sh shim maps OMPI/PMI rank env to our id vars
+_MPI_WORKER_SHIM = ('export DMLC_WORKER_ID='
+                    '"${OMPI_COMM_WORLD_RANK:-${PMI_RANK:-0}}"; exec "$@"')
+_MPI_SERVER_SHIM = ('export TP_SERVER_ID='
+                    '"${OMPI_COMM_WORLD_RANK:-${PMI_RANK:-0}}"; exec "$@"')
+
+
+def build_mpi_commands(num_workers, num_servers, hostfile, base_env,
+                       command, pass_keys=()):
+    """One mpirun per role group with env forwarded via ``-x`` (OpenMPI;
+    the dmlc mpi tracker equivalent).  Returns [(role, argv), ...]."""
+    def mpirun(n, role, shim):
+        env = _remote_env(base_env, role, {}, pass_keys)
+        argv = ["mpirun", "--allow-run-as-root", "-np", str(n)]
+        if hostfile:
+            argv += ["--hostfile", hostfile]
+        for k, v in sorted(env.items()):
+            argv += ["-x", "%s=%s" % (k, v)]
+        return argv + ["sh", "-c", shim, "sh"] + list(command)
+
+    cmds = []
+    if num_servers > 0:
+        cmds.append(("server", mpirun(num_servers, "server",
+                                      _MPI_SERVER_SHIM)))
+    cmds.append(("worker", mpirun(num_workers, "worker",
+                                  _MPI_WORKER_SHIM)))
+    return cmds
+
+
+def _rendezvous_env(args, root_uri):
+    env = dict(os.environ)
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        env[k] = v
+    env["DMLC_NUM_WORKER"] = str(args.num_workers)
+    env["DMLC_NUM_SERVER"] = str(args.num_servers)
+    env["DMLC_PS_ROOT_URI"] = root_uri
+    env["DMLC_PS_ROOT_PORT"] = str(_free_port())
+    env["KVSTORE_COORDINATOR"] = root_uri
+    env["JAX_COORD_PORT"] = str(_free_port())
+    return env
+
+
+class _ProcGroup:
+    def __init__(self):
+        self.procs = []
+
+    def spawn(self, role, argv, env=None):
+        p = subprocess.Popen(argv, env=env)
+        self.procs.append((role, p))
+        return p
+
+    def wait_workers(self):
+        rc = 0
+        for role, p in self.procs:
+            if role != "worker":
+                continue
+            code = p.wait()
+            if code != 0:
+                # signal deaths return negative codes; normalize to the
+                # shell convention so a crashed worker can't read as rc=0
+                rc = max(rc, code if code > 0 else 128 + abs(code))
+        return rc
+
+    def terminate(self):
+        for role, p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        for role, p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def submit_local(args):
+    base_env = _rendezvous_env(args, "127.0.0.1")
+    group = _ProcGroup()
+
+    def spawn(role, extra):
+        env = dict(base_env)
+        env["DMLC_ROLE"] = role
+        env.update(extra)
+        return group.spawn(role, args.command, env)
+
+    try:
+        if args.num_servers > 0:
+            spawn("scheduler", {})
+            for i in range(args.num_servers):
+                spawn("server", {"TP_SERVER_ID": str(i)})
+        for r in range(args.num_workers):
+            spawn("worker", {"DMLC_WORKER_ID": str(r)})
+        return group.wait_workers()
+    finally:
+        group.terminate()
+
+
+def _user_env_keys(args):
+    return tuple(kv.partition("=")[0] for kv in args.env)
+
+
+def submit_ssh(args):
+    hosts = read_hostfile(args.hostfile)
+    base_env = _rendezvous_env(args, _local_ip())
+    # the jax.distributed coordinator runs inside rank-0 worker, wherever
+    # the round-robin plan puts it (the launching host only ever runs the
+    # PS scheduler)
+    base_env["KVSTORE_COORDINATOR"] = worker0_host(
+        args.num_workers, args.num_servers, hosts)
+    workdir = args.sync_dst_dir or os.getcwd()
+    group = _ProcGroup()
+    try:
+        if args.sync_dst_dir:
+            for host, _ in hosts:
+                subprocess.check_call(build_sync_command(
+                    host, os.getcwd(), args.sync_dst_dir))
+        if args.num_servers > 0:
+            # scheduler stays on the launching host (dmlc tracker design)
+            env = dict(base_env)
+            env["DMLC_ROLE"] = "scheduler"
+            group.spawn("scheduler", args.command, env)
+        for role, host, argv in plan_ssh_jobs(
+                args.num_workers, args.num_servers, hosts, base_env,
+                args.command, workdir, _user_env_keys(args)):
+            group.spawn(role, argv)
+        return group.wait_workers()
+    finally:
+        group.terminate()
+
+
+def submit_mpi(args):
+    base_env = _rendezvous_env(args, _local_ip())
+    if args.hostfile:
+        hosts = read_hostfile(args.hostfile)
+        base_env["KVSTORE_COORDINATOR"] = worker0_host(
+            args.num_workers, 0, hosts)  # workers fill from the first host
+    group = _ProcGroup()
+    try:
+        if args.num_servers > 0:
+            env = dict(base_env)
+            env["DMLC_ROLE"] = "scheduler"
+            group.spawn("scheduler", args.command, env)
+        for role, argv in build_mpi_commands(
+                args.num_workers, args.num_servers, args.hostfile,
+                base_env, args.command, _user_env_keys(args)):
+            # the worker-group mpirun is the job's exit status; the
+            # server group is terminated in finally like local servers
+            group.spawn(role, argv, dict(base_env))
+        return group.wait_workers()
+    finally:
+        group.terminate()
+
+
 def main():
     ap = argparse.ArgumentParser(
-        description="Launch a distributed job locally")
+        description="Launch a distributed job")
     ap.add_argument("-n", "--num-workers", type=int, required=True,
                     help="number of worker processes")
     ap.add_argument("-s", "--num-servers", type=int, default=0,
                     help="number of parameter-server processes "
                          "(0 = collective-only transport)")
+    ap.add_argument("-H", "--hostfile", type=str, default=None,
+                    help="hosts to run on (one per line, optionally "
+                         "host:slots) — required for ssh/mpi")
+    ap.add_argument("--sync-dst-dir", type=str, default=None,
+                    help="rsync the working directory to this path on "
+                         "every host before launching (ssh mode)")
     ap.add_argument("--launcher", default="local",
-                    choices=["local"],
-                    help="only the local launcher is provided; cluster "
-                         "schedulers (k8s/slurm) own multi-host spawns "
-                         "for TPU pods")
+                    choices=["local", "ssh", "mpi"],
+                    help="local spawns everything on this machine; "
+                         "ssh/mpi fan out over -H hostfile (TPU pods "
+                         "normally use k8s/slurm instead)")
     ap.add_argument("--env", action="append", default=[],
                     help="extra KEY=VALUE env for all nodes")
     ap.add_argument("command", nargs=argparse.REMAINDER,
@@ -52,56 +322,15 @@ def main():
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
+    if args.launcher == "ssh" and not args.hostfile:
+        # mpi may run without -H (mpirun's own default host set)
+        ap.error("--launcher ssh requires -H hostfile")
 
-    base_env = dict(os.environ)
-    for kv in args.env:
-        k, _, v = kv.partition("=")
-        base_env[k] = v
-    base_env["DMLC_NUM_WORKER"] = str(args.num_workers)
-    base_env["DMLC_NUM_SERVER"] = str(args.num_servers)
-    base_env["DMLC_PS_ROOT_URI"] = "127.0.0.1"
-    base_env["DMLC_PS_ROOT_PORT"] = str(_free_port())
-    base_env["KVSTORE_COORDINATOR"] = "127.0.0.1"
-    base_env["JAX_COORD_PORT"] = str(_free_port())
-
-    procs = []
-
-    def spawn(role, extra):
-        env = dict(base_env)
-        env["DMLC_ROLE"] = role
-        env.update(extra)
-        p = subprocess.Popen(args.command, env=env)
-        procs.append((role, p))
-        return p
-
-    try:
-        if args.num_servers > 0:
-            spawn("scheduler", {})
-            for i in range(args.num_servers):
-                spawn("server", {"TP_SERVER_ID": str(i)})
-        workers = []
-        for r in range(args.num_workers):
-            workers.append(spawn("worker", {"DMLC_WORKER_ID": str(r)}))
-        rc = 0
-        for w in workers:
-            code = w.wait()
-            if code != 0:
-                # signal deaths return negative codes; normalize to the
-                # shell convention so a crashed worker can't read as rc=0
-                rc = max(rc, code if code > 0 else 128 + abs(code))
-        return rc
-    finally:
-        for role, p in procs:
-            if p.poll() is None:
-                try:
-                    p.send_signal(signal.SIGTERM)
-                except OSError:
-                    pass
-        for role, p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+    if args.launcher == "ssh":
+        return submit_ssh(args)
+    if args.launcher == "mpi":
+        return submit_mpi(args)
+    return submit_local(args)
 
 
 if __name__ == "__main__":

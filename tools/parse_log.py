@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Parse a training log into a markdown (or TSV) table
+(reference ``tools/parse_log.py``).
+
+Consumes the ``Module.fit`` log lines::
+
+    Epoch[3] Train-accuracy=0.91
+    Epoch[3] Validation-accuracy=0.89
+    Epoch[3] Time cost=12.3
+
+and prints one averaged row per epoch.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+
+
+_PATTERNS = [re.compile(r".*Epoch\[(\d+)\] Train.*=([.\d]+)"),
+             re.compile(r".*Epoch\[(\d+)\] Valid.*=([.\d]+)"),
+             re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")]
+
+
+def parse(lines):
+    """epoch -> [train_sum, train_n, valid_sum, valid_n, time_sum, time_n]"""
+    data = {}
+    for line in lines:
+        for i, pat in enumerate(_PATTERNS):
+            m = pat.match(line)
+            if m is None:
+                continue
+            epoch, val = int(m.group(1)), float(m.group(2))
+            row = data.setdefault(epoch, [0.0] * (len(_PATTERNS) * 2))
+            row[i * 2] += val
+            row[i * 2 + 1] += 1
+            break
+    return data
+
+
+def _avg(row, i):
+    return row[i * 2] / row[i * 2 + 1] if row[i * 2 + 1] else float("nan")
+
+
+def render(data, fmt="markdown"):
+    out = []
+    if fmt == "markdown":
+        out.append("| epoch | train-accuracy | valid-accuracy | time |")
+        out.append("| --- | --- | --- | --- |")
+        tmpl = "| %2d | %f | %f | %.1f |"
+    else:
+        out.append("epoch\ttrain-accuracy\tvalid-accuracy\ttime")
+        tmpl = "%2d\t%f\t%f\t%.1f"
+    for epoch in sorted(data):
+        row = data[epoch]
+        out.append(tmpl % (epoch + 1, _avg(row, 0), _avg(row, 1),
+                           _avg(row, 2)))
+    return "\n".join(out)
+
+
+def main():
+    ap = argparse.ArgumentParser(description="Parse a training log")
+    ap.add_argument("logfile", nargs=1, type=str)
+    ap.add_argument("--format", type=str, default="markdown",
+                    choices=["markdown", "none"])
+    args = ap.parse_args()
+    with open(args.logfile[0]) as f:
+        data = parse(f.readlines())
+    print(render(data, args.format))
+
+
+if __name__ == "__main__":
+    main()
