@@ -45,7 +45,7 @@ def main():
     layout = os.environ.get("TP_BENCH_LAYOUT", "NHWC")
     image = (3, 32, 32) if small else (3, 224, 224)
     classes = 10 if small else 1000
-    layers = 18 if small else 50
+    layers = 20 if small else 50
 
     import jax
 
@@ -91,7 +91,7 @@ def main():
     img_s = batch * steps / dt
     print(json.dumps({
         "metric": "resnet50_train_imgs_per_sec" if not small
-                  else "resnet18_cifar_train_imgs_per_sec",
+                  else "resnet20_cifar_train_imgs_per_sec",
         "value": round(img_s, 2),
         "unit": "img/s",
         # the P100 anchor is a ResNet-50 number; small mode runs a

@@ -1,0 +1,19 @@
+"""Shared example plumbing (reference ``example/image-classification/common``).
+
+Importing this package makes ``incubator_mxnet_tpu`` importable when the
+examples run from a source checkout (the ``find_mxnet.py`` role).
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+if os.environ.get("TP_EXAMPLES_FORCE_CPU") == "1":
+    # the axon TPU plugin ignores JAX_PLATFORMS=cpu; tests force the CPU
+    # backend via the config API before jax initializes (tests/conftest.py)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

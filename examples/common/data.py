@@ -1,0 +1,101 @@
+"""Example data pipelines (reference
+``example/image-classification/common/data.py``): ImageRecordIter wiring
+plus the ``--benchmark`` synthetic iterator the reference used for perf
+runs (``train_imagenet.py --benchmark 1``)."""
+from __future__ import annotations
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data", "the input images")
+    data.add_argument("--data-train", type=str, default=None,
+                      help="the training data (RecordIO .rec)")
+    data.add_argument("--data-val", type=str, default=None,
+                      help="the validation data (RecordIO .rec)")
+    data.add_argument("--image-shape", type=str, default="3,224,224",
+                      help="the image shape feed into the network, e.g. "
+                           "3,224,224")
+    data.add_argument("--num-classes", type=int, default=1000,
+                      help="the number of classes")
+    data.add_argument("--num-examples", type=int, default=1281167,
+                      help="the number of training examples")
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939",
+                      help="a tuple of size 3 for the mean rgb")
+    data.add_argument("--preprocess-threads", type=int, default=4,
+                      help="decode/augment thread-pool size")
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="1 = use synthetic data to measure train speed")
+    return data
+
+
+def add_aug_args(parser):
+    aug = parser.add_argument_group("Augmentation", "training augmentation")
+    aug.add_argument("--random-crop", type=int, default=1,
+                     help="if or not randomly crop the image")
+    aug.add_argument("--random-mirror", type=int, default=1,
+                     help="if or not randomly flip horizontally")
+    return aug
+
+
+class SyntheticImageIter(mx.io.DataIter):
+    """Fixed random device-shaped batches — the ``--benchmark 1`` data
+    path: measures the train step without any input pipeline."""
+
+    def __init__(self, num_classes, data_shape, num_batches, dtype="float32"):
+        super().__init__(data_shape[0])
+        self.num_batches = num_batches
+        rng = np.random.RandomState(0)
+        self._data = mx.nd.array(
+            rng.uniform(-1, 1, data_shape).astype(dtype))
+        self._label = mx.nd.array(
+            rng.randint(0, num_classes, (data_shape[0],)).astype("float32"))
+        self.provide_data = [mx.io.DataDesc("data", data_shape, dtype)]
+        self.provide_label = [mx.io.DataDesc(
+            "softmax_label", (data_shape[0],), "float32")]
+        self._cur = 0
+
+    def reset(self):
+        self._cur = 0
+
+    def next(self):
+        if self._cur >= self.num_batches:
+            raise StopIteration
+        self._cur += 1
+        return mx.io.DataBatch(data=[self._data], label=[self._label],
+                               pad=0, index=None,
+                               provide_data=self.provide_data,
+                               provide_label=self.provide_label)
+
+    __next__ = next
+
+
+def get_image_iters(args, kv):
+    """(train, val) iterators: RecordIO when ``--data-train`` is given,
+    synthetic otherwise (so every driver runs out of the box)."""
+    image_shape = tuple(int(d) for d in args.image_shape.split(","))
+    batch_shape = (args.batch_size,) + image_shape
+    if args.benchmark or not args.data_train:
+        n_batches = max(1, args.num_examples // args.batch_size)
+        train = SyntheticImageIter(args.num_classes, batch_shape, n_batches,
+                                   args.dtype)
+        return train, None
+
+    mean = [float(v) for v in args.rgb_mean.split(",")]
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=image_shape,
+        batch_size=args.batch_size, shuffle=True,
+        rand_crop=bool(getattr(args, "random_crop", 1)),
+        rand_mirror=bool(getattr(args, "random_mirror", 1)),
+        mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+        preprocess_threads=args.preprocess_threads)
+    val = None
+    if args.data_val:
+        val = mx.io.ImageRecordIter(
+            path_imgrec=args.data_val, data_shape=image_shape,
+            batch_size=args.batch_size, shuffle=False,
+            mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+            preprocess_threads=args.preprocess_threads)
+    return train, val

@@ -1,0 +1,192 @@
+"""Shared training harness for the example drivers (reference
+``example/image-classification/common/fit.py:1-200``): the ``--network
+--batch-size --kv-store ...`` CLI and the kvstore-aware ``Module.fit``
+wiring every BASELINE config runs through."""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import incubator_mxnet_tpu as mx
+
+
+def add_fit_args(parser):
+    train = parser.add_argument_group("Training", "model training")
+    train.add_argument("--network", type=str,
+                       help="the neural network to use")
+    train.add_argument("--num-layers", type=int,
+                       help="number of layers, for networks such as resnet")
+    train.add_argument("--gpus", type=str, default=None,
+                       help="list of accelerator devices to run on, e.g. "
+                            "'0' or '0,1' (mx.gpu aliases the TPU chip); "
+                            "empty means cpu")
+    train.add_argument("--kv-store", type=str, default="device",
+                       help="key-value store type")
+    train.add_argument("--num-epochs", type=int, default=100,
+                       help="max num of epochs")
+    train.add_argument("--lr", type=float, default=0.1,
+                       help="initial learning rate")
+    train.add_argument("--lr-factor", type=float, default=0.1,
+                       help="the ratio to reduce lr on each step")
+    train.add_argument("--lr-step-epochs", type=str, default=None,
+                       help="the epochs to reduce the lr, e.g. 30,60")
+    train.add_argument("--optimizer", type=str, default="sgd",
+                       help="the optimizer type")
+    train.add_argument("--mom", type=float, default=0.9,
+                       help="momentum for sgd")
+    train.add_argument("--wd", type=float, default=0.0001,
+                       help="weight decay for sgd")
+    train.add_argument("--batch-size", type=int, default=128,
+                       help="the batch size")
+    train.add_argument("--disp-batches", type=int, default=20,
+                       help="show progress for every n batches")
+    train.add_argument("--model-prefix", type=str, default=None,
+                       help="model checkpoint prefix")
+    train.add_argument("--monitor", type=int, default=0,
+                       help="log network parameters every N iters if >0")
+    train.add_argument("--load-epoch", type=int, default=None,
+                       help="load the model saved at this epoch")
+    train.add_argument("--top-k", type=int, default=0,
+                       help="also report top-k accuracy (0 = off)")
+    train.add_argument("--test-io", type=int, default=0,
+                       help="1 means test reading speed without training")
+    train.add_argument("--dtype", type=str, default="float32",
+                       help="precision: float32 or bfloat16 (the "
+                            "reference's float16 role)")
+    return train
+
+
+def _devices(args):
+    if not getattr(args, "gpus", None):
+        return mx.cpu() if mx.context.num_tpus() == 0 else mx.tpu(0)
+    return [mx.gpu(int(i)) for i in args.gpus.split(",")]
+
+
+def _get_lr_scheduler(args, kv, epoch_size):
+    if args.lr_factor is None or args.lr_factor >= 1 \
+            or not args.lr_step_epochs:
+        return args.lr, None
+    if "dist" in args.kv_store:
+        epoch_size //= kv.num_workers
+    epoch_size = max(1, epoch_size)
+    begin_epoch = args.load_epoch or 0
+    step_epochs = [int(e) for e in args.lr_step_epochs.split(",")]
+    lr = args.lr
+    for s in step_epochs:
+        if begin_epoch >= s:
+            lr *= args.lr_factor
+    if lr != args.lr:
+        logging.info("Adjust learning rate to %e for epoch %d",
+                     lr, begin_epoch)
+    steps = [epoch_size * (e - begin_epoch) for e in step_epochs
+             if e - begin_epoch > 0]
+    if not steps:
+        return lr, None
+    return lr, mx.lr_scheduler.MultiFactorScheduler(
+        step=steps, factor=args.lr_factor)
+
+
+def _load_model(args, rank=0):
+    if args.load_epoch is None:
+        return None, None, None
+    assert args.model_prefix is not None
+    prefix = args.model_prefix
+    if rank > 0 and os.path.exists("%s-%d-symbol.json" % (prefix, rank)):
+        prefix += "-%d" % rank
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        prefix, args.load_epoch)
+    logging.info("Loaded model %s_%04d.params", prefix, args.load_epoch)
+    return sym, arg_params, aux_params
+
+
+def _save_model(args, rank=0):
+    if args.model_prefix is None:
+        return None
+    dst_dir = os.path.dirname(args.model_prefix)
+    if dst_dir and not os.path.isdir(dst_dir):
+        os.makedirs(dst_dir, exist_ok=True)
+    prefix = args.model_prefix if rank == 0 \
+        else "%s-%d" % (args.model_prefix, rank)
+    return mx.callback.do_checkpoint(prefix)
+
+
+def fit(args, network, data_loader, **kwargs):
+    """Train ``network`` with the iterators from ``data_loader(args, kv)``."""
+    kv = mx.kv.create(args.kv_store)
+    head = "%(asctime)-15s Node[" + str(kv.rank) + "] %(message)s"
+    logging.basicConfig(level=logging.INFO, format=head, force=True)
+    logging.info("start with arguments %s", args)
+
+    train, val = data_loader(args, kv)
+
+    if args.test_io:
+        tic = time.time()
+        for i, batch in enumerate(train):
+            for d in batch.data:
+                d.wait_to_read()
+            if (i + 1) % args.disp_batches == 0:
+                logging.info("Batch [%d]\tSpeed: %.2f samples/sec", i,
+                             args.disp_batches * args.batch_size
+                             / (time.time() - tic))
+                tic = time.time()
+        return None
+
+    if "arg_params" in kwargs and "aux_params" in kwargs:
+        arg_params = kwargs.pop("arg_params")
+        aux_params = kwargs.pop("aux_params")
+    else:
+        sym, arg_params, aux_params = _load_model(args, kv.rank)
+        if sym is not None:
+            assert sym.tojson() == network.tojson()
+
+    checkpoint = _save_model(args, kv.rank)
+    devs = _devices(args)
+
+    epoch_size = getattr(args, "num_examples", 0) // args.batch_size
+    lr, lr_scheduler = _get_lr_scheduler(args, kv, epoch_size)
+
+    optimizer_params = {"learning_rate": lr, "wd": args.wd,
+                        "lr_scheduler": lr_scheduler}
+    if args.optimizer in ("sgd", "nag", "dcasgd"):
+        optimizer_params["momentum"] = args.mom
+
+    if args.network == "alexnet":
+        # AlexNet will not converge using Xavier (reference fit.py note)
+        initializer = mx.init.Normal()
+    else:
+        initializer = mx.init.Xavier(rnd_type="gaussian",
+                                     factor_type="in", magnitude=2)
+
+    eval_metrics = ["accuracy"]
+    if args.top_k > 0:
+        eval_metrics.append(mx.metric.create("top_k_accuracy",
+                                             top_k=args.top_k))
+
+    batch_end_callbacks = [mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches)]
+    extra_cb = kwargs.pop("batch_end_callback", None)
+    if extra_cb is not None:
+        batch_end_callbacks += extra_cb if isinstance(extra_cb, list) \
+            else [extra_cb]
+    monitor = mx.monitor.Monitor(args.monitor, pattern=".*") \
+        if args.monitor > 0 else None
+
+    model = mx.mod.Module(symbol=network, context=devs)
+    model.fit(train,
+              begin_epoch=args.load_epoch or 0,
+              num_epoch=args.num_epochs,
+              eval_data=val,
+              eval_metric=kwargs.pop("eval_metric", eval_metrics),
+              kvstore=kv,
+              optimizer=args.optimizer,
+              optimizer_params=optimizer_params,
+              initializer=initializer,
+              arg_params=arg_params,
+              aux_params=aux_params,
+              batch_end_callback=batch_end_callbacks,
+              epoch_end_callback=checkpoint,
+              allow_missing=True,
+              monitor=monitor,
+              **kwargs)
+    return model

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Train cifar10 (reference
+``example/image-classification/train_cifar10.py``)::
+
+    python examples/train_cifar10.py --network resnet --num-layers 20
+
+Synthetic 32x32 data unless ``--data-train`` points at a RecordIO pack."""
+import argparse
+import logging
+
+from common import data, fit
+
+import incubator_mxnet_tpu as mx
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train cifar10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    data.add_aug_args(parser)
+    parser.set_defaults(network="resnet", num_layers=20,
+                        num_classes=10, num_examples=50000,
+                        image_shape="3,32,32",
+                        batch_size=128, num_epochs=300,
+                        lr=0.05, lr_step_epochs="200,250")
+    args = parser.parse_args()
+    image_shape = tuple(int(d) for d in args.image_shape.split(","))
+    sym = mx.models.resnet(num_layers=args.num_layers,
+                           num_classes=args.num_classes,
+                           image_shape=image_shape) \
+        if args.network == "resnet" else \
+        mx.models.get_symbol(args.network, num_classes=args.num_classes,
+                             image_shape=image_shape)
+    fit.fit(args, sym, data.get_image_iters)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    main()

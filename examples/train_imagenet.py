@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Train on imagenet-class data (BASELINE configs 2/5; reference
+``example/image-classification/train_imagenet.py``)::
+
+    # synthetic perf run (the reference's --benchmark 1)
+    python examples/train_imagenet.py --network resnet --num-layers 50 \
+        --benchmark 1 --batch-size 256 --num-epochs 1
+
+    # real RecordIO data (packed with tools/im2rec.py)
+    python examples/train_imagenet.py --network resnet --num-layers 50 \
+        --data-train train.rec --data-val val.rec
+
+    # distributed (under tools/launch.py)
+    python tools/launch.py -n 4 python examples/train_imagenet.py \
+        --network resnet --num-layers 50 --benchmark 1 --kv-store dist_sync
+"""
+import argparse
+import logging
+
+from common import data, fit
+
+import incubator_mxnet_tpu as mx
+
+
+def get_network(args):
+    image_shape = tuple(int(d) for d in args.image_shape.split(","))
+    name = args.network
+    if name == "resnet":
+        return mx.models.resnet(num_layers=args.num_layers or 50,
+                                num_classes=args.num_classes,
+                                image_shape=image_shape,
+                                dtype=args.dtype)
+    if name == "vgg":
+        return mx.models.vgg(num_layers=args.num_layers or 16,
+                             num_classes=args.num_classes)
+    if name == "alexnet":
+        return mx.models.alexnet(num_classes=args.num_classes)
+    if name in ("inception-bn", "inception_bn"):
+        return mx.models.inception_bn(num_classes=args.num_classes)
+    return mx.models.get_symbol(name, num_classes=args.num_classes,
+                                image_shape=image_shape)
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train imagenet-class networks",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    data.add_aug_args(parser)
+    parser.set_defaults(network="resnet", num_layers=50,
+                        num_classes=1000, num_examples=1281167,
+                        image_shape="3,224,224",
+                        batch_size=128, num_epochs=80,
+                        lr=0.1, lr_step_epochs="30,60,80",
+                        dtype="float32")
+    args = parser.parse_args()
+    fit.fit(args, get_network(args), data.get_image_iters)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    main()

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Train mnist (BASELINE config 1; reference
+``example/image-classification/train_mnist.py``)::
+
+    python examples/train_mnist.py --network lenet --num-epochs 2
+
+Uses ``mx.io.MNISTIter`` — real ubyte files when present under
+``--data-dir``, deterministic synthetic digits otherwise."""
+import argparse
+import logging
+
+from common import fit  # noqa: F401  (sys.path bootstrap)
+
+import incubator_mxnet_tpu as mx
+
+
+def get_mnist_iter(args, kv):
+    import os
+    flat = args.network == "mlp"
+    d = args.data_dir
+    train = mx.io.MNISTIter(
+        image=os.path.join(d, "train-images-idx3-ubyte"),
+        label=os.path.join(d, "train-labels-idx1-ubyte"),
+        batch_size=args.batch_size, shuffle=True,
+        num_examples=args.num_examples, seed=0, flat=flat)
+    val = mx.io.MNISTIter(
+        image=os.path.join(d, "t10k-images-idx3-ubyte"),
+        label=os.path.join(d, "t10k-labels-idx1-ubyte"),
+        batch_size=args.batch_size, shuffle=False,
+        num_examples=max(args.batch_size, args.num_examples // 6),
+        seed=1, flat=flat)
+    return train, val
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--data-dir", type=str, default="data",
+                        help="directory holding the MNIST ubyte(.gz) "
+                             "files; synthetic digits when absent")
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="mlp", num_epochs=10, lr=0.05,
+                        lr_step_epochs="10", batch_size=64,
+                        kv_store="local")
+    args = parser.parse_args()
+
+    if args.network == "mlp":
+        sym = mx.models.mlp(num_classes=args.num_classes)
+    else:
+        sym = mx.models.lenet(num_classes=args.num_classes)
+    fit.fit(args, sym, get_mnist_iter)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    main()

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Train a PTB-style LSTM language model (BASELINE config 3; reference
+``example/rnn/lstm_bucketing.py``)::
+
+    python examples/train_ptb_lstm.py --num-epochs 5
+
+Reads PTB text via ``--data-train ptb.train.txt`` (one sentence per line)
+when given; otherwise generates a synthetic corpus so the driver runs
+hermetically."""
+import argparse
+import logging
+
+from common import fit  # noqa: F401  (sys.path bootstrap)
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+
+BUCKETS = [10, 20, 30, 40, 50, 60]
+
+
+def tokenize_text(fname, vocab=None, invalid_label=0, start_label=1):
+    with open(fname) as f:
+        lines = [line.split() for line in f]
+    return mx.rnn.encode_sentences(lines, vocab=vocab,
+                                   invalid_label=invalid_label,
+                                   start_label=start_label)
+
+
+def synthetic_corpus(num_sentences, vocab_size, seed=0):
+    rng = np.random.RandomState(seed)
+    # first-order Markov chains so there is actual structure to learn
+    trans = rng.dirichlet(np.ones(vocab_size) * 0.1, size=vocab_size)
+    out = []
+    for _ in range(num_sentences):
+        w = int(rng.randint(1, vocab_size))
+        s = [w]
+        for _ in range(int(rng.randint(4, 30))):
+            w = int(rng.choice(vocab_size, p=trans[w]))
+            s.append(max(1, w))
+        out.append(s)
+    return out
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train a PTB-style LSTM LM",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--data-train", type=str, default=None)
+    parser.add_argument("--data-val", type=str, default=None)
+    parser.add_argument("--vocab-size", type=int, default=200,
+                        help="synthetic-corpus vocabulary size")
+    parser.add_argument("--num-sentences", type=int, default=512,
+                        help="synthetic-corpus size")
+    parser.add_argument("--num-embed", type=int, default=200)
+    parser.add_argument("--num-hidden", type=int, default=200)
+    parser.add_argument("--num-lstm-layers", type=int, default=2)
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="lstm", batch_size=32, num_epochs=25,
+                        lr=0.01, optimizer="sgd", kv_store="local")
+    args = parser.parse_args()
+    kv = mx.kv.create(args.kv_store)
+    head = "%(asctime)-15s Node[" + str(kv.rank) + "] %(message)s"
+    logging.basicConfig(level=logging.INFO, format=head, force=True)
+    logging.info("start with arguments %s", args)
+
+    if args.data_train:
+        sentences, vocab = tokenize_text(args.data_train)
+        vocab_size = len(vocab) + 1
+        val_sentences = None
+        if args.data_val:
+            val_sentences, _ = tokenize_text(args.data_val, vocab=vocab)
+    else:
+        vocab_size = args.vocab_size
+        sentences = synthetic_corpus(args.num_sentences, vocab_size)
+        val_sentences = synthetic_corpus(max(32, args.num_sentences // 8),
+                                         vocab_size, seed=1)
+
+    train = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                      buckets=BUCKETS, invalid_label=0)
+    val = mx.rnn.BucketSentenceIter(val_sentences, args.batch_size,
+                                    buckets=BUCKETS, invalid_label=0) \
+        if val_sentences else None
+
+    from incubator_mxnet_tpu.models.lstm_ptb import lstm_ptb_sym_gen
+    sym_gen = lstm_ptb_sym_gen(num_embed=args.num_embed,
+                               num_hidden=args.num_hidden,
+                               num_layers=args.num_lstm_layers,
+                               vocab_size=vocab_size, fused=True)
+    mod = mx.mod.BucketingModule(
+        sym_gen, default_bucket_key=train.default_bucket_key,
+        context=fit._devices(args))
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            kvstore=kv, optimizer=args.optimizer,
+            optimizer_params={"learning_rate": args.lr, "wd": args.wd},
+            initializer=mx.init.Xavier(),
+            eval_metric=mx.metric.Perplexity(ignore_label=0),
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, args.disp_batches))
+    return mod
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Train SSD object detection (BASELINE config 4; reference
+``example/ssd/train.py``)::
+
+    # full SSD-300 VGG16
+    python examples/train_ssd.py --data-shape 300
+
+    # fast smoke config (3 scales, 64x64)
+    python examples/train_ssd.py --small-config --data-shape 64 \
+        --num-epochs 2
+
+Consumes an image list + directory (``--image-list``/``--data-root``,
+the ``.lst`` convention of tools/im2rec.py); generates a small synthetic
+detection set otherwise."""
+import argparse
+import logging
+import os
+import tempfile
+
+from common import fit
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+
+SMALL_CFG = dict(
+    from_layers=["relu4_3", "relu7", ""],
+    num_filters=[512, -1, 256],
+    strides=[-1, -1, 2],
+    pads=[-1, -1, 1],
+    sizes=[[0.2, 0.272], [0.45, 0.55], [0.8, 0.9]],
+    ratios=[[1, 2, 0.5]] * 3,
+    normalizations=[20, -1, -1],
+    steps=[],
+)
+
+
+class MultiBoxMetric(mx.metric.EvalMetric):
+    """Training loss over the Group([cls_prob, loc_loss, cls_label, det])
+    outputs: class cross-entropy + smooth-l1 localization (the reference
+    ``example/ssd/train/metric.py`` MultiBoxMetric)."""
+
+    def __init__(self, eps=1e-8):
+        super().__init__("multibox_loss")
+        self.eps = eps
+
+    def update(self, labels, preds):
+        cls_prob = preds[0].asnumpy()    # (B, C+1, N)
+        loc_loss = preds[1].asnumpy()
+        cls_label = preds[2].asnumpy()   # (B, N)
+        valid = cls_label >= 0
+        label = np.clip(cls_label.astype(np.int64), 0, None)
+        prob = np.take_along_axis(cls_prob, label[:, None, :],
+                                  axis=1).squeeze(1)
+        ce = -np.log(np.maximum(prob, self.eps))[valid].sum()
+        self.sum_metric += float(ce + loc_loss.sum())
+        self.num_inst += max(int(valid.sum()), 1)
+
+
+def synthetic_det_dataset(num_images, num_classes, seed=0):
+    """Write random JPEGs + box labels, return (root, imglist)."""
+    import cv2
+
+    root = tempfile.mkdtemp(prefix="ssd_synth_")
+    rng = np.random.RandomState(seed)
+    imglist = []
+    for i in range(num_images):
+        img = rng.randint(0, 255, (160, 160, 3)).astype(np.uint8)
+        name = "img_%d.jpg" % i
+        cv2.imwrite(os.path.join(root, name), img)
+        label = [2, 5]
+        for _ in range(rng.randint(1, 4)):
+            x1, y1 = rng.uniform(0, 0.5, 2)
+            w, h = rng.uniform(0.2, 0.4, 2)
+            label.extend([float(rng.randint(0, num_classes)), x1, y1,
+                          min(x1 + w, 1.0), min(y1 + h, 1.0)])
+        imglist.append([np.array(label, np.float32), name])
+    return root, imglist
+
+
+def read_lst(path):
+    """tools/im2rec.py ``.lst`` rows: idx \t label... \t relpath"""
+    imglist = []
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            label = np.array([float(v) for v in parts[1:-1]], np.float32)
+            imglist.append([label, parts[-1]])
+    return imglist
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train an SSD detector",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--image-list", type=str, default=None,
+                        help=".lst file (id, det label, path rows)")
+    parser.add_argument("--data-root", type=str, default=None,
+                        help="image directory the .lst paths are "
+                             "relative to")
+    parser.add_argument("--data-shape", type=int, default=300,
+                        help="input image side")
+    parser.add_argument("--num-classes", type=int, default=20)
+    parser.add_argument("--num-examples", type=int, default=16,
+                        help="synthetic dataset size when no --image-list")
+    parser.add_argument("--small-config", action="store_true",
+                        help="3-scale reduced SSD (fast smoke runs)")
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="ssd", batch_size=4, num_epochs=240,
+                        lr=0.004, wd=0.0005, kv_store="local")
+    args = parser.parse_args()
+    kv = mx.kv.create(args.kv_store)
+    head = "%(asctime)-15s Node[" + str(kv.rank) + "] %(message)s"
+    logging.basicConfig(level=logging.INFO, format=head, force=True)
+    logging.info("start with arguments %s", args)
+
+    if args.image_list:
+        imglist = read_lst(args.image_list)
+        root = args.data_root or os.path.dirname(args.image_list)
+    else:
+        root, imglist = synthetic_det_dataset(args.num_examples,
+                                              args.num_classes)
+
+    hw = args.data_shape
+    it = mx.image.ImageDetIter(batch_size=args.batch_size,
+                               data_shape=(3, hw, hw),
+                               imglist=imglist, path_root=root,
+                               shuffle=True, rand_mirror=True)
+
+    if args.small_config:
+        net = mx.models.ssd_train(num_classes=args.num_classes,
+                                  **SMALL_CFG)
+    else:
+        net = mx.models.ssd_300(num_classes=args.num_classes, train=True)
+
+    mod = mx.mod.Module(net, context=fit._devices(args),
+                        data_names=("data",), label_names=("label",))
+    mod.fit(it, num_epoch=args.num_epochs, kvstore=kv,
+            optimizer=args.optimizer,
+            optimizer_params={"learning_rate": args.lr, "wd": args.wd,
+                              "momentum": args.mom},
+            initializer=mx.init.Xavier(),
+            eval_metric=MultiBoxMetric(),
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, args.disp_batches))
+    return mod
+
+
+if __name__ == "__main__":
+    main()

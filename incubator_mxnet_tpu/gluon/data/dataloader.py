@@ -68,7 +68,8 @@ class DataLoader:
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(self._num_workers) as pool:
+        pool = ThreadPoolExecutor(self._num_workers)
+        try:
             pending = deque()
             for batch in self._batch_sampler:
                 pending.append(pool.submit(self._fetch, batch))
@@ -76,6 +77,11 @@ class DataLoader:
                     yield pending.popleft().result()
             while pending:
                 yield pending.popleft().result()
+        finally:
+            # early abandonment (break / next(iter(...))) must not block
+            # on ~2N queued prefetches: drop what never started, don't
+            # wait for what did
+            pool.shutdown(wait=False, cancel_futures=True)
 
     def __len__(self):
         return len(self._batch_sampler)

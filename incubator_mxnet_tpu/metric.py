@@ -105,6 +105,7 @@ class CompositeEvalMetric(EvalMetric):
 
 
 @_REG.register(name="acc")
+@_REG.register(name="accuracy")
 class Accuracy(EvalMetric):
     def __init__(self, axis=1, name="accuracy", **kwargs):
         super().__init__(name, **kwargs)

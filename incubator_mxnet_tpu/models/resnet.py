@@ -144,7 +144,7 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
     if isinstance(image_shape, str):
         image_shape = tuple(int(x) for x in image_shape.split(","))
     height = image_shape[1]
-    if height <= 28:
+    if height <= 32:            # such as cifar10 (reference resnet.py:117)
         num_stages = 3
         if (num_layers - 2) % 9 == 0 and num_layers >= 164:
             per_unit = [(num_layers - 2) // 9]

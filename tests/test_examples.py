@@ -1,0 +1,82 @@
+"""Smoke-run every BASELINE-config example driver for a few steps
+(reference CLI contract: ``example/image-classification/common/fit.py``,
+``example/rnn``, ``example/ssd``)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run(script, *args, timeout=900):
+    env = dict(os.environ)
+    env["TP_EXAMPLES_FORCE_CPU"] = "1"
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), *args],
+        cwd=EXAMPLES, env=env, capture_output=True, text=True,
+        timeout=timeout)
+    assert proc.returncode == 0, (
+        "%s failed rc=%d\nstdout:\n%s\nstderr:\n%s"
+        % (script, proc.returncode, proc.stdout[-2000:],
+           proc.stderr[-2000:]))
+    return proc.stderr + proc.stdout
+
+
+def test_train_mnist_mlp():
+    out = _run("train_mnist.py", "--network", "mlp", "--num-epochs", "1",
+               "--num-examples", "256", "--batch-size", "64",
+               "--kv-store", "local")
+    assert "Train-accuracy" in out
+
+
+def test_train_mnist_lenet():
+    out = _run("train_mnist.py", "--network", "lenet", "--num-epochs", "1",
+               "--num-examples", "128", "--batch-size", "32",
+               "--kv-store", "local")
+    assert "Train-accuracy" in out
+
+
+def test_train_ptb_lstm():
+    out = _run("train_ptb_lstm.py", "--num-epochs", "1",
+               "--num-sentences", "48", "--vocab-size", "24",
+               "--num-embed", "8", "--num-hidden", "8",
+               "--num-lstm-layers", "1", "--batch-size", "8")
+    assert "perplexity" in out.lower()
+
+
+def test_train_cifar10_test_io():
+    # --test-io exercises the CLI + data path without a training run
+    out = _run("train_cifar10.py", "--test-io", "1", "--num-examples",
+               "512", "--batch-size", "64", "--disp-batches", "2")
+    assert "samples/sec" in out
+
+
+@pytest.mark.slow
+def test_train_cifar10():
+    out = _run("train_cifar10.py", "--num-epochs", "1",
+               "--num-examples", "128", "--batch-size", "32",
+               "--kv-store", "local")
+    assert "Train-accuracy" in out
+
+
+@pytest.mark.slow
+def test_train_imagenet_benchmark():
+    # the reference's --benchmark 1 synthetic perf mode, shrunk
+    out = _run("train_imagenet.py", "--benchmark", "1", "--network",
+               "resnet", "--num-layers", "18", "--image-shape", "3,64,64",
+               "--num-examples", "64", "--batch-size", "32",
+               "--num-epochs", "1", "--kv-store", "local")
+    assert "Train-accuracy" in out
+
+
+@pytest.mark.slow
+def test_train_ssd_small():
+    pytest.importorskip("cv2")
+    out = _run("train_ssd.py", "--small-config", "--data-shape", "64",
+               "--num-epochs", "1", "--num-examples", "8",
+               "--batch-size", "4")
+    assert "multibox_loss" in out

@@ -84,8 +84,22 @@ def test_ssh_quoting():
 
 
 def test_sync_command():
+    # no --delete: the remote destination may hold unrelated files
     argv = launch.build_sync_command("h2", "/src/dir/", "/dst")
-    assert argv == ["rsync", "-az", "--delete", "/src/dir/", "h2:/dst"]
+    assert argv == ["rsync", "-az", "/src/dir/", "h2:/dst"]
+
+
+def test_remote_coordinator_port():
+    import argparse
+    ns = argparse.Namespace(env=[])
+    env = {"JAX_COORD_PORT": "41123"}  # local free-port probe result
+    launch._remote_coordinator(env, ns, "h7")
+    # a locally-probed port proves nothing remotely: framework default
+    assert env == {"KVSTORE_COORDINATOR": "h7", "JAX_COORD_PORT": "9876"}
+    ns2 = argparse.Namespace(env=["JAX_COORD_PORT=5555"])
+    env2 = {"JAX_COORD_PORT": "5555"}
+    launch._remote_coordinator(env2, ns2, "h8")
+    assert env2["JAX_COORD_PORT"] == "5555"  # user pin respected
 
 
 def test_parse_log(tmp_path):

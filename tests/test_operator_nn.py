@@ -159,7 +159,7 @@ def test_resnet_nhwc_matches_nchw():
     transposed data."""
     import incubator_mxnet_tpu as mx
     rng = np.random.RandomState(32)
-    kw = dict(num_layers=18, num_classes=10, image_shape=(3, 32, 32))
+    kw = dict(num_layers=20, num_classes=10, image_shape=(3, 32, 32))
     net_c = mx.models.resnet(**kw)
     net_l = mx.models.resnet(layout="NHWC", **kw)
     x = rng.randn(2, 3, 32, 32).astype(np.float32)

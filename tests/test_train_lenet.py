@@ -30,7 +30,7 @@ def test_model_zoo_shapes():
         (mx.models.mlp(), (2, 784)),
         (mx.models.lenet(), (2, 1, 28, 28)),
         (mx.models.alexnet(num_classes=100), (2, 3, 224, 224)),
-        (mx.models.resnet(num_layers=18, num_classes=10,
+        (mx.models.resnet(num_layers=20, num_classes=10,
                           image_shape=(3, 32, 32)), (2, 3, 32, 32)),
         (mx.models.get_symbol("resnet50", num_classes=1000),
          (2, 3, 224, 224)),
@@ -43,8 +43,8 @@ def test_model_zoo_shapes():
         assert all(s is not None for s in arg_shapes)
 
 
-def test_resnet18_cifar_forward():
-    net = mx.models.resnet(num_layers=18, num_classes=10,
+def test_resnet20_cifar_forward():
+    net = mx.models.resnet(num_layers=20, num_classes=10,
                            image_shape=(3, 32, 32))
     ex = net.simple_bind(ctx=mx.cpu(), data=(2, 3, 32, 32))
     for name, arr in ex.arg_dict.items():

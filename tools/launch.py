@@ -103,8 +103,9 @@ def build_ssh_command(host, env, command, workdir=None, ssh_opts=()):
 
 
 def build_sync_command(host, src_dir, dst_dir):
-    """``rsync -az src/ host:dst`` (the tracker's --sync-dst-dir)."""
-    return ["rsync", "-az", "--delete",
+    """``rsync -az src/ host:dst`` (the tracker's --sync-dst-dir; no
+    --delete — the destination may hold other files)."""
+    return ["rsync", "-az",
             src_dir.rstrip("/") + "/",
             "%s:%s" % (host, dst_dir)]
 
@@ -244,14 +245,24 @@ def _user_env_keys(args):
     return tuple(kv.partition("=")[0] for kv in args.env)
 
 
+def _remote_coordinator(base_env, args, host):
+    """Point the collective coordinator at rank-0 worker's host.  The port
+    must be usable THERE — a local free-port probe proves nothing about a
+    remote machine — so keep the framework default unless the user pinned
+    one via --env JAX_COORD_PORT=..."""
+    base_env["KVSTORE_COORDINATOR"] = host
+    if "JAX_COORD_PORT" not in _user_env_keys(args):
+        base_env["JAX_COORD_PORT"] = "9876"
+
+
 def submit_ssh(args):
     hosts = read_hostfile(args.hostfile)
     base_env = _rendezvous_env(args, _local_ip())
     # the jax.distributed coordinator runs inside rank-0 worker, wherever
     # the round-robin plan puts it (the launching host only ever runs the
     # PS scheduler)
-    base_env["KVSTORE_COORDINATOR"] = worker0_host(
-        args.num_workers, args.num_servers, hosts)
+    _remote_coordinator(base_env, args, worker0_host(
+        args.num_workers, args.num_servers, hosts))
     workdir = args.sync_dst_dir or os.getcwd()
     group = _ProcGroup()
     try:
@@ -277,8 +288,9 @@ def submit_mpi(args):
     base_env = _rendezvous_env(args, _local_ip())
     if args.hostfile:
         hosts = read_hostfile(args.hostfile)
-        base_env["KVSTORE_COORDINATOR"] = worker0_host(
-            args.num_workers, 0, hosts)  # workers fill from the first host
+        # workers fill from the first host
+        _remote_coordinator(base_env, args,
+                            worker0_host(args.num_workers, 0, hosts))
     group = _ProcGroup()
     try:
         if args.num_servers > 0:
