@@ -107,12 +107,19 @@ def _accelerator_devices():
 
 
 def _local_cpu_devices():
+    # the host backend must be requested explicitly: under an accelerator
+    # platform ``jax.local_devices()`` lists only accelerator chips, and
+    # falling back to them would silently place the "cpu" context (and with
+    # it the whole host-side data pipeline) on the accelerator
     import jax
 
     try:
-        return [d for d in jax.local_devices() if d.platform == "cpu"]
+        return list(jax.local_devices(backend="cpu"))
     except RuntimeError:
-        return []
+        try:
+            return [d for d in jax.local_devices() if d.platform == "cpu"]
+        except RuntimeError:
+            return []
 
 
 def _has_cpu() -> bool:

@@ -22,6 +22,7 @@ import numpy as np
 from .. import io as io_mod
 from .. import ndarray as nd
 from ..base import MXNetError
+from . import image as image_mod
 from .image import (Augmenter, CastAug, ColorJitterAug, ColorNormalizeAug,
                     ForceResizeAug, HueJitterAug, LightingAug, RandomGrayAug,
                     ResizeAug, fixed_crop, imdecode, ImageIter)
@@ -449,7 +450,9 @@ class ImageDetIter(ImageIter):
         try:
             while i < batch_size:
                 label, s = self.next_sample()
-                data = imdecode(s)
+                # numpy through the augmenter chain (image._wrap_like):
+                # no per-image device transfers on the host pipeline
+                data = image_mod._imdecode_np(s)
                 try:
                     label = self._parse_label(label)
                     data, label = self.augmentation_transform(data, label)

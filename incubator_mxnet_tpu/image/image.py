@@ -46,9 +46,20 @@ def _require_cv2():
         raise MXNetError("OpenCV (cv2) is required for mx.image")
 
 
-def imdecode(buf, flag=1, to_rgb=True, out=None):
-    """Decode an image byte buffer to HWC ndarray (reference
-    ``image.py:85``; BGR→RGB like the reference's default)."""
+def _wrap_like(src, out):
+    """NDArray in → NDArray out; plain numpy in → numpy out.
+
+    Augmenter math is all cv2/numpy; wrapping every intermediate in an
+    NDArray would round-trip each image through the accelerator once per
+    augmenter step.  Iterators therefore feed numpy through the chain
+    and only the final assembled batch becomes an NDArray."""
+    if isinstance(src, np.ndarray):
+        return out
+    return nd.array(out)
+
+
+def _imdecode_np(buf, flag=1, to_rgb=True):
+    """Decode an image byte buffer to an HWC uint8 numpy array."""
     _require_cv2()
     img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8),
                        cv2.IMREAD_COLOR if flag else
@@ -59,7 +70,13 @@ def imdecode(buf, flag=1, to_rgb=True, out=None):
         img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
     if img.ndim == 2:
         img = img[:, :, None]
-    return nd.array(img)
+    return img
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer to HWC ndarray (reference
+    ``image.py:85``; BGR→RGB like the reference's default)."""
+    return nd.array(_imdecode_np(buf, flag=flag, to_rgb=to_rgb))
 
 
 def imread(filename, flag=1, to_rgb=True):
@@ -76,7 +93,7 @@ def imresize(src, w, h, interp=2):
                      interpolation=_get_interp_method(interp))
     if out.ndim == 2:
         out = out[:, :, None]
-    return nd.array(out)
+    return _wrap_like(src, out)
 
 
 def scale_down(src_size, size):
@@ -127,7 +144,7 @@ def resize_short(src, size, interp=2):
         interp, (h, w, new_h, new_w)))
     if out.ndim == 2:
         out = out[:, :, None]
-    return nd.array(out)
+    return _wrap_like(src, out)
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
@@ -140,7 +157,7 @@ def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
             interp, (h, w, size[1], size[0])))
         if out.ndim == 2:
             out = out[:, :, None]
-    return nd.array(out)
+    return _wrap_like(src, out)
 
 
 def random_crop(src, size, interp=2):
@@ -174,7 +191,7 @@ def color_normalize(src, mean, std=None):
         arr = arr - np.asarray(mean, dtype=np.float32)
     if std is not None:
         arr = arr / np.asarray(std, dtype=np.float32)
-    return nd.array(arr)
+    return _wrap_like(src, arr)
 
 
 def random_size_crop(src, size, min_area, ratio, interp=2):
@@ -305,7 +322,7 @@ def _jitter(src, alpha, mode):
     elif mode == "saturation":
         gray = (arr * coef).sum(axis=2, keepdims=True)
         arr = arr * alpha + gray * (1.0 - alpha)
-    return nd.array(arr)
+    return _wrap_like(src, arr)
 
 
 class BrightnessJitterAug(Augmenter):
@@ -359,7 +376,7 @@ class HueJitterAug(Augmenter):
         bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
                       dtype=np.float32)
         t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
-        return [nd.array(np.dot(arr, t))]
+        return [_wrap_like(src, np.dot(arr, t))]
 
 
 class ColorJitterAug(RandomOrderAug):
@@ -388,7 +405,7 @@ class LightingAug(Augmenter):
             else np.asarray(src)
         alpha = np.random.normal(0, self.alphastd, size=(3,))
         rgb = np.dot(self.eigvec * alpha, self.eigval)
-        return [nd.array(arr.astype(np.float32) + rgb)]
+        return [_wrap_like(src, arr.astype(np.float32) + rgb)]
 
 
 class ColorNormalizeAug(Augmenter):
@@ -414,7 +431,8 @@ class RandomGrayAug(Augmenter):
         if pyrandom.random() < self.p:
             arr = src.asnumpy() if hasattr(src, "asnumpy") \
                 else np.asarray(src)
-            src = nd.array(np.dot(arr.astype(np.float32), self.mat))
+            src = _wrap_like(src, np.dot(arr.astype(np.float32),
+                                         self.mat))
         return [src]
 
 
@@ -427,7 +445,7 @@ class HorizontalFlipAug(Augmenter):
         if pyrandom.random() < self.p:
             arr = src.asnumpy() if hasattr(src, "asnumpy") \
                 else np.asarray(src)
-            src = nd.array(arr[:, ::-1].copy())
+            src = _wrap_like(src, arr[:, ::-1].copy())
         return [src]
 
 
@@ -438,14 +456,24 @@ class CastAug(Augmenter):
     def __call__(self, src):
         arr = src.asnumpy() if hasattr(src, "asnumpy") \
             else np.asarray(src)
-        return [nd.array(arr.astype(np.float32))]
+        return [_wrap_like(src, arr.astype(np.float32))]
 
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False,
                     rand_resize=False, rand_mirror=False, mean=None,
                     std=None, brightness=0, contrast=0, saturation=0,
-                    hue=0, pca_noise=0, rand_gray=0, inter_method=2):
-    """Standard augmenter list (reference ``image.py:861``)."""
+                    hue=0, pca_noise=0, rand_gray=0, inter_method=2,
+                    cast=True):
+    """Standard augmenter list (reference ``image.py:861``).
+
+    ``cast=False`` builds a uint8-transport chain (crop/resize/flip only;
+    no float cast, no host-side color math) — the ImageRecordUInt8Iter
+    configuration where normalization belongs on the device."""
+    if not cast:
+        assert mean is None and std is None and not (
+            brightness or contrast or saturation or hue or pca_noise
+            or rand_gray), \
+            "cast=False keeps color math off the host pipeline"
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
@@ -461,6 +489,8 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False,
         auglist.append(CenterCropAug(crop_size, inter_method))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
+    if not cast:
+        return auglist
     auglist.append(CastAug())
     if brightness or contrast or saturation:
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
@@ -611,7 +641,7 @@ class ImageIter(io_mod.DataIter):
         try:
             while i < batch_size:
                 label, s = self.next_sample()
-                data = [imdecode(s)]
+                data = [_imdecode_np(s)]
                 if data[0].shape[0] < self.data_shape[1] and \
                         not self.auglist:
                     raise MXNetError("image smaller than data_shape")

@@ -24,7 +24,8 @@ from .ndarray import array as nd_array
 from .ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "MNISTIter", "ResizeIter", "PrefetchingIter"]
+           "MNISTIter", "ResizeIter", "PrefetchingIter",
+           "ImageRecordIter", "ImageRecordUInt8Iter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -249,10 +250,19 @@ class MNISTIter(DataIter):
             lab = _read_idx(label)
             data = data.astype(np.float32) / 255.0
             return data, lab.astype(np.float32)
-        # synthetic fallback: 10 fixed class-template images + noise
+        # synthetic fallback: 10 fixed class-template images + noise.
+        # Templates come from a FIXED seed so train (seed=0) and val
+        # (seed=1) iterators share the same class→image mapping and a
+        # model trained on one generalizes to the other; ``seed`` only
+        # drives the per-sample draw.
         n = num_examples or 6000
+        templates = np.random.RandomState(42).rand(
+            10, 28, 28).astype(np.float32)
         rng = np.random.RandomState(seed)
-        templates = rng.rand(10, 28, 28).astype(np.float32)
+        # warm the generator before drawing labels: MT19937's first draws
+        # after a small integer seed are poorly mixed, and an unwarmed
+        # label stream measurably stalls LeNet convergence
+        rng.rand(8192)
         lab = rng.randint(0, 10, n)
         data = templates[lab] + rng.randn(n, 28, 28).astype(np.float32) * 0.3
         return np.clip(data, 0, 1), lab.astype(np.float32)
@@ -446,10 +456,11 @@ class ImageRecordIter(DataIter):
                  rand_crop=False, rand_mirror=False, resize=0,
                  preprocess_threads=4, prefetch_buffer=4, label_width=1,
                  data_name="data", label_name="softmax_label",
-                 round_batch=True, **kwargs):
+                 round_batch=True, dtype="float32", **kwargs):
         super().__init__(batch_size)
         from . import image as image_mod
 
+        self._dtype = np.dtype(dtype)
         mean = None
         std = None
         if mean_r or mean_g or mean_b:
@@ -457,16 +468,32 @@ class ImageRecordIter(DataIter):
         if std_r != 1.0 or std_g != 1.0 or std_b != 1.0:
             std = np.array([std_r, std_g, std_b], dtype=np.float32)
 
-        aug = image_mod.CreateAugmenter(
-            data_shape, resize=resize, rand_crop=rand_crop,
-            rand_mirror=rand_mirror, mean=mean, std=std)
+        if self._dtype == np.uint8:
+            # uint8 transport (reference ImageRecordUInt8Iter,
+            # iter_image_recordio_2.cc:612): crop/resize/flip only on the
+            # host; cast + mean/std normalize belong on the DEVICE, where
+            # they fuse into the first conv — and the host moves 4× fewer
+            # bytes per batch
+            if mean is not None or std is not None or scale != 1.0:
+                raise MXNetError(
+                    "dtype='uint8' keeps normalization on the device; "
+                    "drop mean_*/std_*/scale or use dtype='float32'")
+            aug = image_mod.CreateAugmenter(
+                data_shape, resize=resize, rand_crop=rand_crop,
+                rand_mirror=rand_mirror, cast=False)
+        else:
+            aug = image_mod.CreateAugmenter(
+                data_shape, resize=resize, rand_crop=rand_crop,
+                rand_mirror=rand_mirror, mean=mean, std=std)
         self._scale = scale
         self._inner = image_mod.ImageIter(
             batch_size, data_shape, label_width=label_width,
             path_imgrec=path_imgrec, path_imgidx=path_imgidx,
             shuffle=shuffle, aug_list=aug, data_name=data_name,
             label_name=label_name)
-        self.provide_data = self._inner.provide_data
+        self.provide_data = [
+            DataDesc(d.name, d.shape, self._dtype, d.layout)
+            for d in self._inner.provide_data]
         self.provide_label = self._inner.provide_label
         self._threads = max(1, int(preprocess_threads))
         self._prefetch = max(1, int(prefetch_buffer))
@@ -486,14 +513,18 @@ class ImageRecordIter(DataIter):
         from . import image as image_mod
 
         label, s = item
-        data = [image_mod.imdecode(s)]
+        from .image.image import _imdecode_np
+
+        # numpy end-to-end: decode and every augmenter stay on the host
+        # (image._wrap_like) — no per-image device round-trips
+        data = [_imdecode_np(s)]
         for aug in self._inner.auglist:
             data = [ret for src in data for ret in aug(src)]
         out = []
         for d in data:
             arr = d.asnumpy() if hasattr(d, "asnumpy") else np.asarray(d)
             out.append(np.ascontiguousarray(
-                arr.transpose(2, 0, 1), dtype=np.float32))
+                arr.transpose(2, 0, 1), dtype=self._dtype))
         return label, out
 
     def _start_prefetch(self):
@@ -531,7 +562,7 @@ class ImageRecordIter(DataIter):
                         return
                     take, carry = carry[:bs], carry[bs:]
                     batch_data = np.zeros((bs, c, h, w),
-                                          dtype=np.float32)
+                                          dtype=self._dtype)
                     label_shape = (bs, inner.label_width) \
                         if inner.label_width > 1 else (bs,)
                     batch_label = np.zeros(label_shape,
@@ -590,3 +621,11 @@ class ImageRecordIter(DataIter):
         return batch
 
     __next__ = next
+
+
+def ImageRecordUInt8Iter(*args, **kwargs):
+    """uint8-transport record iterator (reference registration
+    ``iter_image_recordio_2.cc:612``): decode/crop/flip on the host, cast
+    + normalize on the device."""
+    kwargs["dtype"] = "uint8"
+    return ImageRecordIter(*args, **kwargs)

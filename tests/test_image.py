@@ -107,6 +107,31 @@ def test_im2rec_pack_and_image_record_iter(image_dir, tmp_path):
     assert sum(1 for _ in it) == 3
 
 
+def test_image_record_uint8_iter(image_dir, tmp_path):
+    """uint8 transport (reference ImageRecordUInt8Iter,
+    iter_image_recordio_2.cc:612): batches stay uint8; normalization is
+    the device's job."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import im2rec
+
+    prefix = str(tmp_path / "packu8")
+    im2rec.main([prefix, image_dir])
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+        data_shape=(3, 32, 32), batch_size=4, dtype="uint8",
+        rand_crop=True, rand_mirror=True, preprocess_threads=2)
+    batch = next(it)
+    arr = batch.data[0].asnumpy()
+    assert arr.dtype == np.uint8 and arr.shape == (4, 3, 32, 32)
+    assert it.provide_data[0].dtype == np.uint8
+    assert arr.max() > 0  # decoded real pixels, not zeros
+    with pytest.raises(mx.base.MXNetError):
+        mx.io.ImageRecordIter(
+            path_imgrec=prefix + ".rec", data_shape=(3, 32, 32),
+            batch_size=4, dtype="uint8", mean_r=128)
+
+
 def test_image_iter_from_imglist(image_dir):
     files = []
     for cls_i, cls in enumerate(sorted(os.listdir(image_dir))):

@@ -15,13 +15,20 @@ def test_lenet_mnist_convergence():
                           seed=1)
     net = mx.models.lenet(num_classes=10)
     mod = mx.mod.Module(net, context=mx.cpu())
+    # lr 0.05: the tanh LeNet saturates into a dead 10%-accuracy state
+    # for some init/shuffle streams at lr 0.1 + momentum 0.9 (effective
+    # lr 1.0); the smoke test asserts convergence, not lr-robustness
     mod.fit(train, eval_data=val, num_epoch=2, optimizer="sgd",
-            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
             initializer=mx.initializer.Xavier(),
             batch_end_callback=mx.callback.Speedometer(64, 10))
     score = mod.score(train, "acc")[0][1]
     # synthetic MNIST templates are learnable to near-perfect quickly
     assert score > 0.9, "LeNet failed to converge: acc=%.3f" % score
+    # val shares the train templates (fixed template seed in MNISTIter),
+    # so a converged model must also generalize to it
+    val_score = mod.score(val, "acc")[0][1]
+    assert val_score > 0.9, "no generalization: val=%.3f" % val_score
 
 
 def test_model_zoo_shapes():

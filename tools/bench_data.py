@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Data-fed benchmark: ImageRecordIter decode throughput + fed training.
+
+The synthetic-data number in ``bench.py`` mirrors the reference's
+``benchmark_score.py`` (no input pipeline).  The reference's headline
+training numbers, though, are ``train_imagenet.py`` *with* the input
+pipeline (``docs/how_to/perf.md:150-188``).  This script measures that
+path:
+
+1. pack a synthetic JPEG ImageNet-style set with ``tools/im2rec.py``
+   (pre-resized at pack time, the reference's recommended recipe);
+2. iterator-alone decode+augment throughput (img/s) for several
+   ``preprocess_threads`` settings;
+3. end-to-end ImageRecordIter → ``FusedTrainStep`` training img/s with
+   a host-readback execution fence (PERF.md methodology).
+
+Prints one JSON dict with all numbers.  Env knobs: TP_DATA_IMAGES (pack
+size, default 256), TP_DATA_BATCH (default 64), TP_DATA_STEPS (default
+8), TP_DATA_SMALL=1 (tiny net for CPU smoke).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_pack(root: str, n_images: int, size: int = 256) -> str:
+    """Synthesise ``n_images`` JPEGs in a class-per-subdir layout and pack
+    them into a RecordIO file pre-resized so the shorter side is
+    ``size`` (the reference packs ImageNet the same way before
+    training)."""
+    import cv2
+
+    import im2rec
+
+    rng = np.random.RandomState(0)
+    img_root = os.path.join(root, "imgs")
+    for cls in range(8):
+        d = os.path.join(img_root, "c%d" % cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(n_images // 8):
+            # low-frequency content so jpeg size resembles photos, not
+            # white noise (noise inflates decode cost unrealistically)
+            small = rng.randint(0, 255, (16, 16, 3)).astype(np.uint8)
+            img = cv2.resize(small, (size + 64, size), cv2.INTER_CUBIC)
+            cv2.imwrite(os.path.join(d, "i%d.jpg" % i), img,
+                        [cv2.IMWRITE_JPEG_QUALITY, 90])
+    prefix = os.path.join(root, "pack")
+    im2rec.main([prefix, img_root, "--resize", str(size),
+                 "--quality", "90"])
+    return prefix
+
+
+def iterator_throughput(prefix: str, data_shape, batch_size: int,
+                        threads: int, min_images: int = 512) -> float:
+    import incubator_mxnet_tpu as mx
+
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+        data_shape=data_shape, batch_size=batch_size, shuffle=True,
+        rand_crop=True, rand_mirror=True,
+        mean_r=123.68, mean_g=116.78, mean_b=103.94,
+        preprocess_threads=threads, prefetch_buffer=4)
+    # warm one epoch (thread pool spin-up, page cache)
+    for _ in it:
+        pass
+    it.reset()
+    n = 0
+    t0 = time.perf_counter()
+    while n < min_images:
+        try:
+            batch = next(it)
+        except StopIteration:
+            it.reset()
+            continue
+        n += batch.data[0].shape[0]
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def fed_training(prefix: str, data_shape, batch_size: int, steps: int,
+                 threads: int, small: bool) -> float:
+    import jax
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.parallel.mesh import data_parallel_spec
+
+    # NCHW: the iterator already emits contiguous NCHW, and on TPU the
+    # logical layout is normalized by XLA anyway (PERF.md §4.3 measured
+    # NHWC == NCHW) — so feeding NCHW skips a 38 MB host transpose per
+    # batch on the 1-core pipeline host
+    layout = "NCHW"
+    net = mx.models.resnet(
+        num_layers=20 if small else 50,
+        num_classes=10 if small else 1000,
+        image_shape=data_shape, layout=layout,
+        dtype="float32" if small else "bfloat16")
+    image = mx.models.image_data_shape(data_shape, layout)
+    mesh = parallel.default_mesh(1)
+    step = parallel.FusedTrainStep(
+        net, {"data": (batch_size,) + image},
+        {"softmax_label": (batch_size,)},
+        mesh=mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "wd": 1e-4},
+        initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                          factor_type="in", magnitude=2))
+    dspec = data_parallel_spec(mesh, 1 + len(image))
+    lspec = data_parallel_spec(mesh, 1)
+
+    # uint8 transport (ImageRecordUInt8Iter): the 1-core pipeline host
+    # moves 4× fewer bytes per batch; cast + mean/std normalize run on
+    # the device where they fuse into the first conv
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+        data_shape=data_shape, batch_size=batch_size, shuffle=True,
+        rand_crop=True, rand_mirror=True, dtype="uint8",
+        preprocess_threads=threads, prefetch_buffer=4)
+
+    import jax.numpy as jnp
+
+    mean = jnp.array([123.68, 116.78, 103.94],
+                     jnp.float32).reshape(1, 3, 1, 1)
+    istd = jnp.float32(1.0)
+
+    @jax.jit
+    def prep(u8):
+        x = (u8.astype(jnp.float32) - mean) * istd
+        return x.astype(jnp.bfloat16) if not small else x
+
+    def batches():
+        while True:
+            try:
+                yield next(it)
+            except StopIteration:
+                it.reset()
+
+    gen = batches()
+
+    def feed(batch):
+        arr = batch.data[0].asnumpy()  # host-resident: no device readback
+        data = prep(jax.device_put(arr, dspec))
+        label = jax.device_put(batch.label[0].asnumpy().astype(
+            np.float32), lspec)
+        return {"data": data, "softmax_label": label}
+
+    # warmup: compile + fill the prefetch queue
+    step(feed(next(gen)))
+    _sync(step)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        step(feed(next(gen)))
+    _sync(step)
+    dt = time.perf_counter() - t0
+    return batch_size * steps / dt
+
+
+def _sync(step):
+    name = next(iter(step.params))
+    return float(np.asarray(step.params[name]).ravel()[0])
+
+
+def main():
+    small = os.environ.get("TP_DATA_SMALL") == "1"
+    n_images = int(os.environ.get("TP_DATA_IMAGES",
+                                  "64" if small else "256"))
+    batch = int(os.environ.get("TP_DATA_BATCH", "8" if small else "64"))
+    steps = int(os.environ.get("TP_DATA_STEPS", "2" if small else "8"))
+    data_shape = (3, 32, 32) if small else (3, 224, 224)
+    pack_size = 40 if small else 256
+
+    out = {}
+    with tempfile.TemporaryDirectory() as root:
+        t0 = time.perf_counter()
+        prefix = make_pack(root, n_images, pack_size)
+        out["pack_s"] = round(time.perf_counter() - t0, 2)
+        min_images = n_images if small else 512
+        for threads in ([1] if small else [1, 2, 4, 8]):
+            rate = iterator_throughput(prefix, data_shape, batch,
+                                       threads, min_images)
+            out["decode_imgs_per_sec_t%d" % threads] = round(rate, 1)
+        out["fed_train_imgs_per_sec"] = round(
+            fed_training(prefix, data_shape, batch, steps,
+                         threads=4, small=small), 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
