@@ -218,7 +218,12 @@ class DistKVStore(KVStore):
                                   os.environ.get("TP_PROCESS_ID", "0")))
         self._rank = rank
         self._size = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-        self._ps_client = ps.PSClient(rank)
+        # server-replacement recovery (TP_PS_RECOVERY) is only sound for
+        # dist_async: each push applies alone, so a replacement re-seeded
+        # from worker weights resumes cleanly.  A sync-mode merge that
+        # lost a member cannot be reconstructed — sync jobs fail cleanly.
+        recover = None if self.type == "dist_async" else False
+        self._ps_client = ps.PSClient(rank, recover_servers=recover)
         if self._rank == 0:
             # rank 0 toggles server sync mode at create (kvstore.cc:47-50)
             self._ps_client.set_sync(self.type != "dist_async")

@@ -269,9 +269,11 @@ class Scheduler(_Node):
         self.num_servers = num_servers
         self._lock = threading.Condition()
         self._servers: Dict[int, Tuple[str, int]] = {}
+        self._server_gen = 0   # bumped on every (re-)registration
         self._barriers: Dict[Any, int] = {}
         self._barrier_gen: Dict[Any, int] = {}
         self._last_seen: Dict[str, float] = {}
+        self._config: Dict[str, Any] = {}
         self._done = 0
 
     def _handle(self, msg, handler):
@@ -283,15 +285,22 @@ class Scheduler(_Node):
         if cmd == "register_server":
             with self._lock:
                 self._servers[msg["server_id"]] = tuple(msg["addr"])
+                self._server_gen += 1
+                # a rejoining server is alive again by definition
+                self._last_seen["server%d" % msg["server_id"]] = now
                 self._lock.notify_all()
-            return {"status": "ok"}
+            return {"status": "ok", "gen": self._server_gen}
         if cmd == "get_nodes":
+            # min_gen > 0 lets a worker wait for a REPLACEMENT server
+            # after observing a death (the recovery path)
+            min_gen = msg.get("min_gen", 0)
             with self._lock:
-                while len(self._servers) < self.num_servers:
+                while (len(self._servers) < self.num_servers
+                       or self._server_gen < min_gen):
                     if not self._lock.wait(timeout=120):
                         return {"status": "error",
                                 "error": "rendezvous timeout"}
-                return {"status": "ok",
+                return {"status": "ok", "gen": self._server_gen,
                         "servers": [self._servers[i]
                                     for i in sorted(self._servers)]}
         if cmd == "heartbeat":
@@ -317,6 +326,16 @@ class Scheduler(_Node):
                 dead = [n for n, t in self._last_seen.items()
                         if now - t > timeout]
             return {"status": "ok", "dead": dead}
+        if cmd == "put_config":
+            # cluster-wide config (optimizer blob, sync flag) parked at the
+            # scheduler so a REPLACEMENT server can fetch it at register
+            # time instead of waiting for a worker to notice and resend
+            with self._lock:
+                self._config[msg["name"]] = msg["blob"]
+            return {"status": "ok"}
+        if cmd == "get_config":
+            with self._lock:
+                return {"status": "ok", "config": dict(self._config)}
         if cmd == "finalize":
             # workers report completion; when all have, stop the cluster
             with self._lock:
@@ -358,11 +377,17 @@ class PSServer(_Node):
     """
 
     def __init__(self, server_id: int, num_workers: int,
-                 scheduler: Tuple[str, int], host: str = "127.0.0.1"):
+                 scheduler: Tuple[str, int], host: str = "127.0.0.1",
+                 recovery: Optional[bool] = None):
         super().__init__(host, 0)
         self.server_id = server_id
         self.num_workers = num_workers
         self.scheduler = scheduler
+        # a replacement for a dead server starts with DMLC_PS_RECOVERY=1
+        # (ps::Postoffice::is_recovery analog); its store is empty until
+        # workers re-seed it from their local weight copies
+        self.recovery = bool(int(os.environ.get("DMLC_PS_RECOVERY", "0"))) \
+            if recovery is None else recovery
         self.sync_mode = False
         self._store: Dict[Any, np.ndarray] = {}
         self._merge: Dict[Any, Tuple[np.ndarray, int]] = {}
@@ -372,6 +397,17 @@ class PSServer(_Node):
         self._lock = threading.Condition()
 
     def register(self) -> None:
+        if self.recovery:
+            # a replacement server configures itself from the scheduler's
+            # parked config BEFORE announcing its address, so no request
+            # can reach an updater-less server and clobber a weight
+            reply = _rpc(self.scheduler, {"cmd": "get_config"},
+                         connect_retry=60.0)
+            cfg = reply.get("config", {})
+            if "optimizer" in cfg:
+                self._updater = _GET_UPDATER(_loads(cfg["optimizer"]))
+            if "sync" in cfg:
+                self.sync_mode = bool(cfg["sync"])
         _rpc(self.scheduler, {"cmd": "register_server",
                               "server_id": self.server_id,
                               "addr": (self.host, self.port),
@@ -408,8 +444,12 @@ class PSServer(_Node):
         cmd = msg["cmd"]
         if cmd == "init":
             with self._lock:
-                self._store[msg["key"]] = np.array(msg["value"],
-                                                   dtype=np.float32)
+                # on a recovered server the FIRST re-seed wins: later
+                # (staler) worker copies must not roll back updates already
+                # applied on top of the first seed
+                if not (self.recovery and msg["key"] in self._store):
+                    self._store[msg["key"]] = np.array(msg["value"],
+                                                       dtype=np.float32)
             return {"status": "ok"}
         if cmd == "push":
             key, grad = msg["key"], msg["value"]
@@ -485,7 +525,8 @@ class PSClient:
 
     def __init__(self, rank: int,
                  scheduler: Optional[Tuple[str, int]] = None,
-                 bigarray_bound: Optional[int] = None):
+                 bigarray_bound: Optional[int] = None,
+                 recover_servers: Optional[bool] = None):
         env = node_env()
         self.rank = rank
         self.node = "worker%d" % rank
@@ -493,6 +534,14 @@ class PSClient:
                                        env["scheduler_port"])
         self.bigarray_bound = bigarray_bound if bigarray_bound is not None \
             else int(get_env("KVSTORE_BIGARRAY_BOUND", 1 << 19))
+        # TP_PS_RECOVERY=1: on server death, wait for a replacement and
+        # re-seed it instead of failing.  DMLC_PS_RECOVERY marks THIS node
+        # as a rejoin (ps::Postoffice::is_recovery) → barriers are skipped.
+        self.recover_servers = bool(int(
+            os.environ.get("TP_PS_RECOVERY", "0"))) \
+            if recover_servers is None else recover_servers
+        self.is_recovery = bool(int(os.environ.get("DMLC_PS_RECOVERY",
+                                                   "0")))
         reply = _rpc(self.scheduler, {"cmd": "get_nodes",
                                       "node": self.node},
                      timeout=180.0, connect_retry=60.0)
@@ -502,6 +551,8 @@ class PSClient:
                                                for a in reply["servers"]]
         if not self.servers:
             raise MXNetError("no servers registered")
+        self._gen = reply.get("gen", 0)
+        self._local: Dict[Any, np.ndarray] = {}  # freshest pulled weights
         self._pool = _ConnPool()
         self._hb = threading.Thread(target=self._heartbeat_loop,
                                     daemon=True)
@@ -543,16 +594,86 @@ class PSClient:
 
         return [(zlib.crc32(str(key).encode()) % n, key, slice(None))]
 
+    # --------------------------------------------------------- fault handling
+    def _data_rpc(self, sidx: int, msg: Dict[str, Any]) -> Any:
+        """Data-plane RPC with dead-server handling.
+
+        Default: a clean ``MXNetError`` naming the unreachable server and
+        the scheduler's dead-node list (the reference surfaces ps-lite van
+        errors the same way).  With ``recover_servers``: wait for a
+        replacement registration, re-seed it, retry once.
+        """
+        last_exc: Optional[BaseException] = None
+        # up to 3 recovery rounds: one generation bump can satisfy the
+        # wait while OUR server's replacement is still registering (a
+        # different server died too), so the retry may trip again
+        for attempt in range(3):
+            try:
+                return self._pool.rpc(self.servers[sidx], msg)
+            except (ConnectionError, OSError) as exc:
+                last_exc = exc
+                if not self.recover_servers:
+                    break
+                self._recover(sidx)
+        addr = self.servers[sidx]
+        dead: List[str] = []
+        try:
+            dead = self.dead_nodes(timeout=15)
+        except OSError:
+            pass
+        raise MXNetError(
+            "parameter server %d at %s:%d unreachable (%s); "
+            "scheduler dead-node list: %s" %
+            (sidx, addr[0], addr[1], last_exc, dead or "[]")) from last_exc
+
+    def _recover(self, sidx: int) -> None:
+        """Wait for a replacement server and re-seed it with our freshest
+        local weight copies.
+
+        ps-lite has no server-state recovery either (``is_recovery`` only
+        skips barriers); here the worker-side weights — refreshed on every
+        pull — are the surviving replica, so training resumes from at-most-
+        one-round-stale values on the replaced shard.  Async mode only: a
+        sync-mode merge that lost a member cannot be reconstructed, so
+        sync jobs fail cleanly instead (kvstore.py gates the flag).
+        """
+        reply = _rpc(self.scheduler,
+                     {"cmd": "get_nodes", "node": self.node,
+                      "min_gen": self._gen + 1}, timeout=300.0)
+        if reply["status"] != "ok":
+            raise MXNetError("recovery rendezvous failed: %s"
+                             % reply.get("error"))
+        self._gen = reply["gen"]
+        old = list(self.servers)
+        self.servers = [tuple(a) for a in reply["servers"]]
+        self._pool.close()
+        self._pool = _ConnPool()
+        # re-seed every REPLACED server (address changed), not just the
+        # one we tripped over — one generation bump can cover several
+        # near-simultaneous deaths.  Healthy servers keep their (fresher)
+        # state: re-initing them would roll weights back.
+        replaced = {i for i, a in enumerate(self.servers)
+                    if i >= len(old) or tuple(old[i]) != a}
+        replaced.add(sidx)
+        for key, value in self._local.items():
+            for si, subkey, sl in self._plan(key, value):
+                if si in replaced:
+                    self._pool.rpc(self.servers[si],
+                                   {"cmd": "init", "key": subkey,
+                                    "value": value[sl]})
+
     # ------------------------------------------------------------------- api
     def init(self, key, value: np.ndarray) -> None:
+        value = np.asarray(value, dtype=np.float32)
+        if self.recover_servers:  # re-seed source; dead weight otherwise
+            self._local[key] = value.copy()
         for sidx, subkey, sl in self._plan(key, value):
-            self._pool.rpc(self.servers[sidx],
-                           {"cmd": "init", "key": subkey,
-                            "value": value[sl]})
+            self._data_rpc(sidx, {"cmd": "init", "key": subkey,
+                                  "value": value[sl]})
 
     def push(self, key, value: np.ndarray) -> None:
         for sidx, subkey, sl in self._plan(key, value):
-            reply = self._pool.rpc(self.servers[sidx],
+            reply = self._data_rpc(sidx,
                                    {"cmd": "push", "key": subkey,
                                     "rank": self.rank,
                                     "value":
@@ -563,24 +684,35 @@ class PSClient:
     def pull(self, key, like: np.ndarray) -> np.ndarray:
         out = np.empty_like(like)
         for sidx, subkey, sl in self._plan(key, like):
-            reply = self._pool.rpc(self.servers[sidx],
-                                   {"cmd": "pull", "key": subkey,
-                                    "rank": self.rank})
+            reply = self._data_rpc(sidx, {"cmd": "pull", "key": subkey,
+                                          "rank": self.rank})
             if reply["status"] != "ok":
                 raise MXNetError("pull failed: %s" % reply.get("error"))
             out[sl] = reply["value"]
+        if self.recover_servers:
+            self._local[key] = np.array(out, dtype=np.float32, copy=True)
         return out
 
     def set_optimizer(self, optimizer) -> None:
         blob = pickle.dumps(optimizer)
+        # parked at the scheduler too, for replacement-server bootstrap
+        _rpc(self.scheduler, {"cmd": "put_config", "name": "optimizer",
+                              "blob": blob, "node": self.node})
         for addr in self.servers:
             _rpc(addr, {"cmd": "set_updater", "optimizer": blob})
 
     def set_sync(self, sync: bool) -> None:
+        _rpc(self.scheduler, {"cmd": "put_config", "name": "sync",
+                              "blob": bool(sync), "node": self.node})
         for addr in self.servers:
             _rpc(addr, {"cmd": "set_sync", "sync": sync})
 
     def barrier(self, barrier_id="default") -> None:
+        # a rejoining node skips barriers entirely so a mid-round restart
+        # cannot deadlock the healthy group (ps::Postoffice::is_recovery —
+        # kvstore_dist.h:57,95,196 skip the init/exit barriers)
+        if self.is_recovery:
+            return
         reply = _rpc(self.scheduler, {"cmd": "barrier",
                                       "barrier_id": barrier_id,
                                       "node": self.node}, timeout=600)

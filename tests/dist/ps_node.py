@@ -1,0 +1,43 @@
+"""Run one PS-cluster role as a standalone process (fault-test helper).
+
+Usage:
+    python ps_node.py scheduler <num_workers> <num_servers> <port>
+    python ps_node.py server <server_id> <num_workers> <sched_host> <port>
+
+A server started with DMLC_PS_RECOVERY=1 is a replacement for a dead
+server: it bootstraps its config from the scheduler and lets the first
+worker re-seed its store (ps::Postoffice::is_recovery analog).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from incubator_mxnet_tpu import ps  # noqa: E402
+
+
+def main():
+    role = sys.argv[1]
+    if role == "scheduler":
+        nw, ns, port = int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+        sched = ps.Scheduler(nw, ns, port=port)
+        print("scheduler up %s:%d" % (sched.host, sched.port), flush=True)
+        sched.run()
+    elif role == "server":
+        sid, nw = int(sys.argv[2]), int(sys.argv[3])
+        host, port = sys.argv[4], int(sys.argv[5])
+        ps.bind_runtime()
+        srv = ps.PSServer(sid, nw, (host, port))
+        srv.start()
+        srv.register()
+        print("server %d up %s:%d recovery=%s"
+              % (sid, srv.host, srv.port, srv.recovery), flush=True)
+        srv._stopped.wait()
+    else:
+        raise SystemExit("unknown role %r" % role)
+
+
+if __name__ == "__main__":
+    main()

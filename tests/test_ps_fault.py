@@ -1,0 +1,183 @@
+"""Parameter-server fault handling: clean failure + recovery.
+
+Reference analog: ps-lite's liveness machinery —
+``ps::Postoffice::GetDeadNodes`` (kvstore_dist.h:177-190) and the
+``is_recovery()`` rejoin semantics that skip barriers
+(kvstore_dist.h:57,95,196).  The reference has no server-state recovery;
+here the worker re-seeds a replacement server from its freshest pulled
+weights, so this suite asserts MORE than parity: a killed server either
+surfaces a clean error (default) or is transparently replaced
+(TP_PS_RECOVERY).
+"""
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import ps
+from incubator_mxnet_tpu.base import MXNetError
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+NODE = os.path.join(HERE, "dist", "ps_node.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(args, env=None):
+    full_env = dict(os.environ)
+    full_env["JAX_PLATFORMS"] = "cpu"
+    if env:
+        full_env.update(env)
+    return subprocess.Popen([sys.executable, NODE] + [str(a) for a in args],
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                            text=True, env=full_env)
+
+
+class _Cluster:
+    """scheduler + N server subprocesses; the client runs in-process."""
+
+    def __init__(self, num_servers=2, num_workers=1):
+        self.port = _free_port()
+        self.num_workers = num_workers
+        self.sched = _spawn(["scheduler", num_workers, num_servers,
+                             self.port])
+        self.servers = [
+            _spawn(["server", i, num_workers, "127.0.0.1", self.port])
+            for i in range(num_servers)]
+        self.procs = [self.sched] + self.servers
+
+    def kill_server(self, idx):
+        self.servers[idx].send_signal(signal.SIGKILL)
+        self.servers[idx].wait(timeout=30)
+
+    def respawn_server(self, idx):
+        self.servers[idx] = _spawn(
+            ["server", idx, self.num_workers, "127.0.0.1", self.port],
+            env={"DMLC_PS_RECOVERY": "1"})
+        self.procs.append(self.servers[idx])
+
+    def shutdown(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.kill()
+        for p in self.procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+
+
+@pytest.fixture
+def cluster():
+    c = _Cluster(num_servers=2, num_workers=1)
+    yield c
+    c.shutdown()
+
+
+def _owner_of(client, key, arr):
+    (sidx, _, _), = client._plan(key, arr)
+    return sidx
+
+
+@pytest.mark.slow
+def test_server_death_is_a_clean_error(cluster):
+    """Default mode: a dead server surfaces as MXNetError naming the
+    server and the scheduler's dead-node view — not a raw socket trace."""
+    c = ps.PSClient(0, scheduler=("127.0.0.1", cluster.port),
+                    recover_servers=False)
+    w = np.arange(8, dtype=np.float32)
+    c.init("w", w)
+    np.testing.assert_array_equal(c.pull("w", w), w)
+
+    cluster.kill_server(_owner_of(c, "w", w))
+    with pytest.raises(MXNetError, match="unreachable"):
+        for _ in range(3):  # first op after death must already fail clean
+            c.push("w", w)
+            time.sleep(0.2)
+
+
+@pytest.mark.slow
+def test_server_death_recovery_reseed(cluster):
+    """TP_PS_RECOVERY path: kill the owning server mid-run, start a
+    replacement (DMLC_PS_RECOVERY=1), and the same worker continues —
+    weights resume from its freshest pulled copy."""
+    c = ps.PSClient(0, scheduler=("127.0.0.1", cluster.port),
+                    recover_servers=True)
+    w0 = np.full(8, 1.0, np.float32)
+    c.init("w", w0)
+    # async semantics without an updater: push stores the value
+    c.push("w", np.full(8, 2.0, np.float32))
+    np.testing.assert_array_equal(c.pull("w", w0), 2.0)  # caches 2.0
+
+    victim = _owner_of(c, "w", w0)
+    cluster.kill_server(victim)
+    cluster.respawn_server(victim)
+
+    # next op transparently waits for the replacement, re-seeds it with
+    # the cached 2.0 weights, then applies the push
+    c.push("w", np.full(8, 3.0, np.float32))
+    np.testing.assert_array_equal(c.pull("w", w0), 3.0)
+
+    # an untouched key on the re-seeded server still resolves after a
+    # fresh pull-after-reseed round-trip
+    c.init("v", np.full(8, 7.0, np.float32))
+    np.testing.assert_array_equal(c.pull("v", w0), 7.0)
+    c.finalize()
+
+
+@pytest.mark.slow
+def test_recovering_node_skips_barriers(cluster):
+    """A node marked DMLC_PS_RECOVERY=1 must not count toward (or block
+    on) barriers — the is_recovery contract, kvstore_dist.h:57,95,196."""
+    os.environ["DMLC_PS_RECOVERY"] = "1"
+    try:
+        c = ps.PSClient(0, scheduler=("127.0.0.1", cluster.port))
+    finally:
+        del os.environ["DMLC_PS_RECOVERY"]
+    assert c.is_recovery
+    t0 = time.time()
+    # num_workers=1 but barrier ids are fresh: a non-recovery client
+    # would release instantly too, so assert via a 2-worker scheduler
+    # expectation instead: the recovery client returns immediately even
+    # for a barrier no other node ever joins
+    c2 = _Cluster(num_servers=1, num_workers=2)
+    try:
+        cr = ps.PSClient(1, scheduler=("127.0.0.1", c2.port))
+        cr.is_recovery = True
+        cr.barrier("never-joined-by-anyone")
+        assert time.time() - t0 < 30
+    finally:
+        c2.shutdown()
+
+
+@pytest.mark.slow
+def test_replacement_server_bootstraps_config(cluster):
+    """set_sync/set_optimizer are parked at the scheduler; a replacement
+    server picks them up at register time (no un-configured window)."""
+    c = ps.PSClient(0, scheduler=("127.0.0.1", cluster.port),
+                    recover_servers=True)
+    c.set_sync(False)
+    from incubator_mxnet_tpu import optimizer as opt
+
+    c.set_optimizer(opt.create("sgd", learning_rate=0.5,
+                               rescale_grad=1.0))
+    w = np.zeros(4, np.float32)
+    c.init("w", w)
+
+    victim = _owner_of(c, "w", w)
+    cluster.kill_server(victim)
+    cluster.respawn_server(victim)
+
+    # with the sgd updater live on the REPLACEMENT server:
+    # w <- w - lr * grad = 0 - 0.5 * 1 = -0.5
+    c.push("w", np.ones(4, np.float32))
+    np.testing.assert_allclose(c.pull("w", w), -0.5, rtol=1e-6)
