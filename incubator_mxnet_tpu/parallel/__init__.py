@@ -11,13 +11,19 @@ Components:
   analog for model parallelism);
 - :mod:`.collectives` — psum/all_gather/reduce_scatter/ppermute wrappers;
 - :mod:`.fused` — ``FusedTrainStep``: forward+backward+optimizer in one
-  compiled XLA program over an arbitrary (dp, tp) mesh.
+  compiled XLA program over an arbitrary (dp, tp) mesh;
+- :mod:`.sequence` — long-context sequence/context parallelism: ring
+  attention (ppermute K/V rotation + online softmax) and Ulysses
+  all-to-all attention.
 """
 from .mesh import build_mesh, default_mesh, data_parallel_spec
 from .collectives import (all_reduce, all_gather, reduce_scatter,
                           ring_permute, barrier_sync)
 from .fused import FusedTrainStep
+from .sequence import (attention, ring_attention, ulysses_attention,
+                       sequence_parallel_attention)
 
 __all__ = ["build_mesh", "default_mesh", "data_parallel_spec",
            "all_reduce", "all_gather", "reduce_scatter", "ring_permute",
-           "barrier_sync", "FusedTrainStep"]
+           "barrier_sync", "FusedTrainStep", "attention", "ring_attention",
+           "ulysses_attention", "sequence_parallel_attention"]

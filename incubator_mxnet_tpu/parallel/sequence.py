@@ -1,0 +1,166 @@
+"""Long-context sequence/context parallelism: ring attention + Ulysses.
+
+The reference (MXNet v0.11) predates attention entirely — its long-sequence
+story is bucketing + truncated BPTT (SURVEY.md §5.7).  The capability row
+to match is "scale sequence length"; on TPU the idiomatic designs are:
+
+- **ring attention** (`ring_attention`): Q stays resident, K/V blocks
+  rotate around the mesh axis via ``lax.ppermute`` (ICI neighbor hops)
+  while a streaming/flash-style online softmax accumulates the output —
+  memory per chip is O(seq/n), and the K/V hop overlaps with the local
+  block matmul.
+- **Ulysses / all-to-all** (`ulysses_attention`): ``lax.all_to_all``
+  re-shards seq→heads, full attention runs locally per head group, then
+  heads→seq restores the layout.  Cheaper collectives for moderate
+  sequence lengths when heads ≥ mesh axis.
+
+Both are shard_map-ready: call them inside ``shard_map`` with the sequence
+axis sharded over ``axis_name``; `sequence_parallel_attention` wraps that
+for convenience.  Shapes follow (batch, heads, seq, head_dim).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+__all__ = ["attention", "ring_attention", "ulysses_attention",
+           "sequence_parallel_attention"]
+
+
+def _neg_inf(dtype):
+    import jax.numpy as jnp
+    import numpy as np
+
+    return jnp.asarray(np.finfo(np.dtype(dtype).name if
+                                np.dtype(dtype).kind == "f"
+                                else "float32").min, dtype)
+
+
+def attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
+              q_offset=0, k_offset=0):
+    """Plain softmax attention on local shards (the oracle and the
+    building block).  ``q_offset``/``k_offset`` are the GLOBAL positions
+    of the first row/column — causal masking stays correct when q and k
+    are shards of a longer sequence."""
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    scale = (1.0 / d ** 0.5) if scale is None else scale
+    s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if causal:
+        qi = q_offset + jnp.arange(q.shape[-2])
+        ki = k_offset + jnp.arange(k.shape[-2])
+        s = jnp.where(qi[:, None] >= ki[None, :], s, _neg_inf(s.dtype))
+    p = jnp.exp(s - s.max(-1, keepdims=True))
+    return jnp.einsum("...qk,...kd->...qd", p / p.sum(-1, keepdims=True),
+                      v)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Ring self-attention over a sharded sequence axis.
+
+    Call inside shard_map: q/k/v are the LOCAL sequence shards
+    (batch, heads, seq/n, d).  K/V rotate n−1 hops around the ring
+    (``ppermute``); an online softmax (running max ``m``, normalizer
+    ``l``, accumulator ``o`` — the flash-attention recurrence) makes the
+    streaming accumulation exact, not approximate.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    bq = q.shape[-2]
+    d = q.shape[-1]
+    scale = (1.0 / d ** 0.5) if scale is None else scale
+    neg = _neg_inf(jnp.float32)
+
+    q32 = q.astype(jnp.float32)
+    # derive the carries from q so they inherit its varying ('sp') axes —
+    # fresh jnp.zeros would be unvarying and reject the scan carry
+    m = jnp.full_like(q32[..., 0], neg)
+    l = jnp.zeros_like(q32[..., 0])
+    o = jnp.zeros_like(q32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    q_off = idx * bq
+
+    def body(step, carry):
+        kk, vv, m, l, o = carry
+        # block (kk, vv) originated on ring neighbor (idx - step) mod n
+        owner = (idx - step) % n
+        s = jnp.einsum("...qd,...kd->...qk", q32,
+                       kk.astype(jnp.float32)) * scale
+        if causal:
+            qi = q_off + jnp.arange(bq)
+            ki = owner * kk.shape[-2] + jnp.arange(kk.shape[-2])
+            s = jnp.where(qi[:, None] >= ki[None, :], s, neg)
+        m_new = jnp.maximum(m, s.max(-1))
+        # fully-masked rows: keep exp argument finite
+        p = jnp.exp(s - jnp.where(m_new == neg, 0.0, m_new)[..., None])
+        if causal:
+            p = jnp.where((qi[:, None] >= ki[None, :]), p, 0.0)
+        corr = jnp.where(m == neg, 0.0,
+                         jnp.exp(m - jnp.where(m_new == neg, 0.0, m_new)))
+        l = l * corr + p.sum(-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "...qk,...kd->...qd", p, vv.astype(jnp.float32))
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return kk, vv, jnp.maximum(m, m_new), l, o
+
+    _, _, m, l, o = lax.fori_loop(0, n, body, (k, v, m, l, o))
+    return (o / jnp.where(l == 0.0, 1.0, l)[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None):
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
+
+    Inside shard_map with seq sharded on ``axis_name``: all_to_all trades
+    the seq shard for a heads shard (heads must divide by the axis size),
+    attention runs over the FULL sequence locally, and a reverse
+    all_to_all restores the seq sharding.
+    """
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    if q.shape[1] % n:
+        raise ValueError("heads (%d) must be divisible by axis size %d"
+                         % (q.shape[1], n))
+    # (b, h, s/n, d) → (b, h/n, s, d): split heads, concat sequence
+    def fwd(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = fwd(q), fwd(k), fwd(v)
+    out = attention(qg, kg, vg, causal=causal, scale=scale)
+    # (b, h/n, s, d) → (b, h, s/n, d)
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def sequence_parallel_attention(mesh, q, k, v, axis_name: str = "sp",
+                                causal: bool = False,
+                                scale: Optional[float] = None,
+                                mode: str = "ring"):
+    """Jit-compiled sequence-parallel attention over ``mesh``.
+
+    q/k/v are GLOBAL arrays (b, h, s, d); the sequence axis is sharded
+    over ``axis_name`` and the chosen kernel (``ring`` or ``ulysses``)
+    runs under shard_map.
+    """
+    import jax
+    from jax.experimental.shard_map import shard_map
+
+    P = jax.sharding.PartitionSpec
+    spec = P(None, None, axis_name, None)
+    fn = ring_attention if mode == "ring" else ulysses_attention
+    sharded = shard_map(
+        functools.partial(fn, axis_name=axis_name, causal=causal,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return jax.jit(sharded)(q, k, v)
