@@ -52,8 +52,9 @@ def main():
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import parallel
 
+    stem = os.environ.get("TP_BENCH_STEM", "7x7")
     net = mx.models.resnet(num_layers=layers, num_classes=classes,
-                           image_shape=image, layout=layout,
+                           image_shape=image, layout=layout, stem=stem,
                            dtype="float32" if small else "bfloat16")
     image = mx.models.image_data_shape(image, layout)
     mesh = parallel.default_mesh(1)

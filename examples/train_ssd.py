@@ -137,10 +137,12 @@ def main():
 
     mod = mx.mod.Module(net, context=fit._devices(args),
                         data_names=("data",), label_names=("label",))
+    optimizer_params = {"learning_rate": args.lr, "wd": args.wd}
+    if args.optimizer in ("sgd", "nag", "dcasgd"):  # fit.py:151 guard
+        optimizer_params["momentum"] = args.mom
     mod.fit(it, num_epoch=args.num_epochs, kvstore=kv,
             optimizer=args.optimizer,
-            optimizer_params={"learning_rate": args.lr, "wd": args.wd,
-                              "momentum": args.mom},
+            optimizer_params=optimizer_params,
             initializer=mx.init.Xavier(),
             eval_metric=MultiBoxMetric(),
             batch_end_callback=mx.callback.Speedometer(

@@ -83,26 +83,63 @@ def residual_unit(data, num_filter, stride, dim_match, name,
     return conv2 + shortcut
 
 
+def _space_to_depth(data, image_shape, layout, block=2):
+    """Re-lay (H, W, C) → (H/b, W/b, C·b²) so the stem conv reads a
+    128-lane-friendly channel dim instead of C=3 (which tiles 3/128 lanes
+    and makes the input BN/conv HBM-pathological — PERF.md §3).
+
+    Both layouts merge channels in the SAME (bh, bw, c) order, preserving
+    the repo's cross-layout contract: the identical OIHW weights load
+    into the NCHW and NHWC nets directly (test_resnet_nhwc_matches_nchw).
+    """
+    if layout == "NHWC":
+        h, w, c = image_shape
+        r = sym.Reshape(data, shape=(0, h // block, block, w // block,
+                                     block, c))
+        t = sym.transpose(r, axes=(0, 1, 3, 2, 4, 5))
+        return sym.Reshape(t, shape=(0, h // block, w // block,
+                                     c * block * block))
+    c, h, w = image_shape
+    r = sym.Reshape(data, shape=(0, c, h // block, block, w // block,
+                                 block))
+    # (N, c, h2, bh, w2, bw) → (N, bh, bw, c, h2, w2): channel-minor c,
+    # matching the NHWC merge order above
+    t = sym.transpose(r, axes=(0, 3, 5, 1, 2, 4))
+    return sym.Reshape(t, shape=(0, c * block * block, h // block,
+                                 w // block))
+
+
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
            bottle_neck=True, bn_mom=0.9, workspace=256, dtype="float32",
-           memonger=False, layout="NCHW"):
+           memonger=False, layout="NCHW", stem="7x7"):
     num_unit = len(units)
     assert num_unit == num_stages
     ax = _bn_axis(layout)
     data = sym.Variable(name="data")
     if dtype == "float16" or dtype == "bfloat16":
         data = sym.Cast(data, dtype=dtype)
+    height = image_shape[1] if layout == "NCHW" else image_shape[0]
+    s2d = stem == "s2d" and height > 32
+    if s2d:
+        # space-to-depth stem (the standard TPU ResNet reformulation):
+        # 224²×3 → 112²×12 re-lay, then a stride-1 3×3 conv — removes the
+        # C=3 tiling pathology and the 112² stem-activation traffic.
+        # Accuracy-equivalent variant, opt-in (weights are not
+        # checkpoint-compatible with the 7×7 stem).
+        data = _space_to_depth(data, image_shape, layout)
     data = sym.BatchNorm(data, fix_gamma=True, eps=2e-5, momentum=bn_mom,
                          axis=ax, name="bn_data")
-    height = image_shape[1] if layout == "NCHW" else image_shape[0]
     if height <= 32:  # cifar-style stem
         body = sym.Convolution(data, num_filter=filter_list[0],
                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
                                no_bias=True, layout=layout, name="conv0")
-    else:  # imagenet stem
-        body = sym.Convolution(data, num_filter=filter_list[0],
-                               kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                               no_bias=True, layout=layout, name="conv0")
+    else:  # imagenet stem (7×7/2 reference form, or 3×3/1 on s2d input)
+        body = sym.Convolution(
+            data, num_filter=filter_list[0],
+            kernel=(3, 3) if s2d else (7, 7),
+            stride=(1, 1) if s2d else (2, 2),
+            pad=(1, 1) if s2d else (3, 3),
+            no_bias=True, layout=layout, name="conv0")
         body = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, axis=ax,
                              momentum=bn_mom, name="bn0")
         body = sym.Activation(body, act_type="relu", name="relu0")

@@ -184,6 +184,37 @@ def test_resnet_nhwc_matches_nchw():
     np.testing.assert_allclose(out_l, out_c, rtol=1e-4, atol=1e-5)
 
 
+def test_resnet_s2d_nhwc_matches_nchw():
+    """The space-to-depth stem merges channels in the same (bh, bw, c)
+    order in both layouts, so the direct-weight-load contract holds for
+    stem="s2d" too."""
+    import incubator_mxnet_tpu as mx
+    rng = np.random.RandomState(34)
+    kw = dict(num_layers=18, num_classes=10, image_shape=(3, 64, 64),
+              stem="s2d")
+    net_c = mx.models.resnet(**kw)
+    net_l = mx.models.resnet(layout="NHWC", **kw)
+    x = rng.randn(2, 3, 64, 64).astype(np.float32)
+    ex_c = net_c.simple_bind(grad_req="null", data=(2, 3, 64, 64),
+                             softmax_label=(2,))
+    ex_l = net_l.simple_bind(grad_req="null", data=(2, 64, 64, 3),
+                             softmax_label=(2,))
+    rngp = np.random.RandomState(35)
+    for n in sorted(ex_c.arg_dict):
+        if n in ("data", "softmax_label"):
+            continue
+        v = rngp.uniform(-0.1, 0.1,
+                         ex_c.arg_dict[n].shape).astype(np.float32)
+        assert ex_l.arg_dict[n].shape == v.shape, (n, v.shape)
+        ex_c.arg_dict[n][:] = mx.nd.array(v)
+        ex_l.arg_dict[n][:] = mx.nd.array(v)
+    ex_c.arg_dict["data"][:] = mx.nd.array(x)
+    ex_l.arg_dict["data"][:] = mx.nd.array(np.transpose(x, (0, 2, 3, 1)))
+    out_c = ex_c.forward(is_train=False)[0].asnumpy()
+    out_l = ex_l.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out_l, out_c, rtol=1e-4, atol=1e-5)
+
+
 def _np_deconv2d(x, w, stride, pad, kernel, adj=(0, 0)):
     n, cin, h, wd = x.shape
     _, cout, kh, kw = w.shape
