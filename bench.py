@@ -52,7 +52,9 @@ def main():
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import parallel
 
-    stem = os.environ.get("TP_BENCH_STEM", "7x7")
+    # s2d measured +3% over the 7×7 stem (PERF.md §5); TP_BENCH_STEM=7x7
+    # for the reference-form A/B
+    stem = os.environ.get("TP_BENCH_STEM", "s2d")
     net = mx.models.resnet(num_layers=layers, num_classes=classes,
                            image_shape=image, layout=layout, stem=stem,
                            dtype="float32" if small else "bfloat16")
@@ -63,6 +65,7 @@ def main():
         mesh=mesh, optimizer="sgd",
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
                           "wd": 1e-4},
+        flat_optimizer=os.environ.get("TP_BENCH_FLATOPT") == "1",
         initializer=mx.initializer.Xavier(rnd_type="gaussian",
                                           factor_type="in", magnitude=2))
 
@@ -90,7 +93,7 @@ def main():
     dt = time.perf_counter() - t0
 
     img_s = batch * steps / dt
-    print(json.dumps({
+    record = {
         "metric": "resnet50_train_imgs_per_sec" if not small
                   else "resnet20_cifar_train_imgs_per_sec",
         "value": round(img_s, 2),
@@ -98,7 +101,12 @@ def main():
         # the P100 anchor is a ResNet-50 number; small mode runs a
         # different net, so the ratio would be meaningless there
         "vs_baseline": None if small else round(img_s / BASELINE_IMG_S, 3),
-    }))
+        # config provenance: these knobs change what is measured
+        "stem": stem, "batch": batch, "layout": layout,
+    }
+    if os.environ.get("TP_BENCH_FLATOPT") == "1":
+        record["flat_optimizer"] = True
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -114,6 +114,8 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
            memonger=False, layout="NCHW", stem="7x7"):
     num_unit = len(units)
     assert num_unit == num_stages
+    if stem not in ("7x7", "s2d"):
+        raise ValueError("stem must be '7x7' or 's2d', got %r" % (stem,))
     ax = _bn_axis(layout)
     data = sym.Variable(name="data")
     if dtype == "float16" or dtype == "bfloat16":

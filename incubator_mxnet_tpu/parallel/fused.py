@@ -52,7 +52,8 @@ class FusedTrainStep:
                  mesh=None, optimizer: str = "sgd",
                  optimizer_params: Optional[Dict[str, Any]] = None,
                  initializer=None, dtype=None, seed: int = 0,
-                 param_partition: Optional[Dict[str, Any]] = None):
+                 param_partition: Optional[Dict[str, Any]] = None,
+                 flat_optimizer: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -88,6 +89,16 @@ class FusedTrainStep:
                              % optimizer)
         opt_params.setdefault("rescale_grad", 1.0 / self.global_batch)
         self._opt_attrs = opt_params
+        # flat mode: one fused update over the concatenation of every
+        # parameter instead of one small op per parameter (measured 38%
+        # SLOWER on ResNet-50 — PERF.md §7 — kept as an option).  Invalid
+        # with per-param partition specs: a flat 1-D buffer has no
+        # per-tensor sharding.
+        if flat_optimizer and param_partition:
+            raise MXNetError("flat_optimizer is incompatible with "
+                             "param_partition (no per-tensor sharding on "
+                             "a flat buffer)")
+        self._flat_opt = bool(flat_optimizer)
         self.num_update = 0
 
         # ---- parameter init (host, then shard) --------------------------
@@ -165,14 +176,43 @@ class FusedTrainStep:
                   {k: jnp.zeros_like(v) for k, v in new_aux.items()})
             (grads,) = vjp_fn(ct)
 
+            attrs = dict(opt_attrs, lr=lr)
             new_params, new_states = {}, {}
-            for name, w in params.items():
-                g = grads[name].astype(w.dtype)
-                attrs = dict(opt_attrs, lr=lr)
-                res, _ = opt_op.apply([w, g] + list(opt_states[name]),
-                                      attrs, OpContext(is_train=True))
-                new_params[name] = res[0]
-                new_states[name] = tuple(res[1:1 + n_states])
+            if self._flat_opt:
+                # grouped by dtype: concatenating bf16 weights with f32
+                # biases would silently promote the whole buffer (and the
+                # donated pytree's dtypes) to f32
+                groups: Dict[Any, List[str]] = {}
+                for n in params:
+                    groups.setdefault(params[n].dtype, []).append(n)
+                for names in groups.values():
+                    flat_w = jnp.concatenate(
+                        [params[n].reshape(-1) for n in names])
+                    flat_g = jnp.concatenate(
+                        [grads[n].astype(params[n].dtype).reshape(-1)
+                         for n in names])
+                    flat_s = [jnp.concatenate(
+                        [opt_states[n][i].reshape(-1) for n in names])
+                        for i in range(n_states)]
+                    res, _ = opt_op.apply([flat_w, flat_g] + flat_s,
+                                          attrs, OpContext(is_train=True))
+                    off = 0
+                    for n in names:
+                        size = params[n].size
+                        new_params[n] = res[0][off:off + size].reshape(
+                            params[n].shape)
+                        new_states[n] = tuple(
+                            res[1 + i][off:off + size].reshape(
+                                params[n].shape)
+                            for i in range(n_states))
+                        off += size
+            else:
+                for name, w in params.items():
+                    g = grads[name].astype(w.dtype)
+                    res, _ = opt_op.apply([w, g] + list(opt_states[name]),
+                                          attrs, OpContext(is_train=True))
+                    new_params[name] = res[0]
+                    new_states[name] = tuple(res[1:1 + n_states])
             return new_params, new_states, new_aux, outs
 
         dp = lambda ndim: data_parallel_spec(self.mesh, ndim)  # noqa: E731

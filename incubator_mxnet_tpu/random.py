@@ -27,12 +27,19 @@ def _ensure():
 
 
 def seed(seed_state: int) -> None:
-    """``mx.random.seed(n)`` — reset the global sample stream."""
+    """``mx.random.seed(n)`` — reset the global sample stream.
+
+    Also seeds numpy's global RNG: the initializer zoo draws on the host
+    through numpy, and the reference contract is that ``mx.random.seed``
+    alone makes network init reproducible (``resource.cc:145`` seeds
+    every device RNG the initializers use)."""
     import jax
+    import numpy as np
 
     _state.seed = int(seed_state)
     _state.root = jax.random.PRNGKey(int(seed_state))
     _state.counter = 0
+    np.random.seed(int(seed_state) % (1 << 32))
 
 
 def current_seed() -> int:

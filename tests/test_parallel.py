@@ -135,3 +135,31 @@ def test_fused_step_dynamic_lr_no_recompile():
     for _ in range(5):
         step({"data": x, "softmax_label": y})
     assert step.num_update == 5
+
+
+def test_fused_step_flat_optimizer_matches_per_param():
+    """flat_optimizer=True (one concatenated update kernel) is
+    numerically identical to the per-parameter update path."""
+    net = _mlp(4)
+    rng = np.random.RandomState(7)
+    x = rng.rand(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, 16).astype(np.float32)
+
+    def run(flat):
+        mx.random.seed(5)  # initializer draws from the global stream
+        step = parallel.FusedTrainStep(
+            net, {"data": (16, 8)}, {"softmax_label": (16,)},
+            mesh=parallel.build_mesh({"dp": 2}), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "wd": 1e-3},
+            initializer=mx.initializer.Uniform(0.07), seed=3,
+            flat_optimizer=flat)
+        for _ in range(4):
+            step({"data": x, "softmax_label": y})
+        return {k: np.asarray(v) for k, v in step.params.items()}
+
+    ref = run(False)
+    flat = run(True)
+    for k in ref:
+        np.testing.assert_allclose(flat[k], ref[k], rtol=1e-6,
+                                   atol=1e-7, err_msg=k)
