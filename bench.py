@@ -55,6 +55,7 @@ def main():
     # s2d measured +3% over the 7×7 stem (PERF.md §5); TP_BENCH_STEM=7x7
     # for the reference-form A/B
     stem = os.environ.get("TP_BENCH_STEM", "s2d")
+    flat_opt = os.environ.get("TP_BENCH_FLATOPT") == "1"
     net = mx.models.resnet(num_layers=layers, num_classes=classes,
                            image_shape=image, layout=layout, stem=stem,
                            dtype="float32" if small else "bfloat16")
@@ -65,7 +66,7 @@ def main():
         mesh=mesh, optimizer="sgd",
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
                           "wd": 1e-4},
-        flat_optimizer=os.environ.get("TP_BENCH_FLATOPT") == "1",
+        flat_optimizer=flat_opt,
         initializer=mx.initializer.Xavier(rnd_type="gaussian",
                                           factor_type="in", magnitude=2))
 
@@ -104,7 +105,7 @@ def main():
         # config provenance: these knobs change what is measured
         "stem": stem, "batch": batch, "layout": layout,
     }
-    if os.environ.get("TP_BENCH_FLATOPT") == "1":
+    if flat_opt:
         record["flat_optimizer"] = True
     print(json.dumps(record))
 

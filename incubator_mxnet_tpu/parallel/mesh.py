@@ -55,3 +55,15 @@ def replicated_spec(mesh):
     import jax
 
     return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+
+def shard_map_fn():
+    """``jax.shard_map`` with fallback to the pre-0.8 experimental path."""
+    import jax
+
+    try:
+        return jax.shard_map
+    except AttributeError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map

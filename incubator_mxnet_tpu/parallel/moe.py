@@ -1,0 +1,91 @@
+"""Expert parallelism (ep): a top-1 gated mixture-of-experts FFN.
+
+Not present in the reference (v0.11 predates MoE); included because the
+framework's distribution layer is first-class: experts shard one-per-
+device over the ``ep`` mesh axis and tokens travel by ``lax.all_to_all``
+(the standard TPU MoE dispatch — the collective rides ICI exactly like
+the sequence all-to-all in :mod:`.sequence`).
+
+Dispatch uses per-source-slot addressing: source device *s* reserves its
+own slot range on every expert, so capacity is exact (no token drops, no
+cumsum bookkeeping) at the cost of an (E, T_local, d) dispatch buffer —
+the right trade at the scales this targets.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["moe_ffn", "expert_parallel_moe"]
+
+
+def moe_ffn(x, gate_w, w1, w2, axis_name: str = "ep"):
+    """Top-1 MoE FFN on shard_map-local shards.
+
+    x (T, d): this device's tokens.  gate_w (d, E) replicated.
+    w1 (d, h), w2 (h, d): THIS device's expert (one expert per device,
+    E = axis size).  Returns (T, d): each token processed by its argmax
+    expert, scaled by the gate probability (top-1 Switch routing).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    E = lax.axis_size(axis_name)
+    T, d = x.shape
+    logits = x @ gate_w                      # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)      # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+    # dispatch[e, t] = x[t] if token t routes to expert e else 0
+    onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)       # (T, E)
+    dispatch = jnp.einsum("te,td->etd", onehot, x)          # (E, T, d)
+    # all_to_all: expert dim → sources dim; device e now holds, for every
+    # source s, the tokens s routed to expert e: (E_src, T, d)
+    recv = lax.all_to_all(dispatch, axis_name, split_axis=0,
+                          concat_axis=0, tiled=True)
+    # local expert FFN over all received tokens
+    h = jax.nn.relu(recv.reshape(E * T, d) @ w1)
+    y = (h @ w2).reshape(E, T, d)
+    # return trip: back to the token's home device
+    back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)                       # (E, T, d)
+    # combine: token t's output sits in back[expert[t], t]
+    combined = jnp.einsum("te,etd->td", onehot, back)
+    return combined * gate[:, None]
+
+
+def expert_parallel_moe(mesh, x, gate_w, w1_stacked, w2_stacked,
+                        axis_name: str = "ep"):
+    """Jit-compiled expert-parallel MoE over ``mesh``.
+
+    x (T, d) sharded over ``axis_name`` on tokens; w1_stacked (E, d, h) /
+    w2_stacked (E, h, d) sharded one expert per device; gate_w replicated.
+    """
+    return _build_moe(mesh, axis_name)(x, gate_w, w1_stacked, w2_stacked)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def _build_moe(mesh, axis_name):
+    """Cached jitted MoE — a fresh closure per call would defeat
+    jax.jit's cache and retrace/recompile every step."""
+    import jax
+
+    from .mesh import shard_map_fn
+
+    P = jax.sharding.PartitionSpec
+
+    def body(x, gw, w1, w2):
+        import jax.numpy as jnp
+
+        return moe_ffn(x, gw, jnp.squeeze(w1, 0), jnp.squeeze(w2, 0),
+                       axis_name)
+
+    fn = shard_map_fn()(body, mesh=mesh,
+                        in_specs=(P(axis_name), P(), P(axis_name),
+                                  P(axis_name)),
+                        out_specs=P(axis_name))
+    return jax.jit(fn)

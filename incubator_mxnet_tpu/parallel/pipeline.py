@@ -1,0 +1,111 @@
+"""Pipeline parallelism (pp): stages laid out over a mesh axis.
+
+The reference's only model parallelism is manual layer placement via
+``group2ctx`` + ``_CrossDeviceCopy`` (``graph_executor.cc:279-393``),
+demonstrated by the model-parallel LSTM example.  The TPU-native
+generalization is a collective-permute pipeline: device *i* holds stage
+*i*'s parameters, microbatches flow device→device over ICI via
+``lax.ppermute`` inside one jitted program (GPipe schedule: M + L − 1
+ticks for M microbatches through L stages), so stage compute and the
+activation hop overlap the way ``_CrossDeviceCopy`` engine ops did.
+
+All stages must share one activation shape (the classic constraint);
+width changes belong inside a stage.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+__all__ = ["pipeline_apply", "pipeline_parallel_apply"]
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
+                   axis_name: str = "pp"):
+    """Run microbatches through the stage pipeline (shard_map body).
+
+    stage_fn(params, x) -> y with ``y.shape == x.shape``; stage_params is
+    the LOCAL stage's parameter pytree (sharded over ``axis_name`` by the
+    caller); ``x_microbatches`` (M, ...) is replicated — device 0 injects
+    microbatch t at tick t, device L−1 collects the finished microbatch
+    at tick t ≥ L−1.  Returns (M, ...) outputs, replicated via a final
+    psum so every stage sees the result (loss is usually computed on the
+    last stage; replication keeps the API simple at toy scale).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    L = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    perm = [(i, i + 1) for i in range(L - 1)]  # no wraparound: a chain
+
+    # the carries must be marked device-varying over the pipeline axis
+    # (the loop writes per-stage values into them); fresh zeros would be
+    # unvarying and rejected as a scan carry under shard_map
+    state = jnp.zeros_like(x_microbatches[0])
+    outs = jnp.zeros_like(x_microbatches)
+    if hasattr(lax, "pcast"):
+        state = lax.pcast(state, (axis_name,), to="varying")
+        outs = lax.pcast(outs, (axis_name,), to="varying")
+
+    def tick(t, carry):
+        state, outs = carry
+        # device 0 injects microbatch t (a dummy repeat past the end —
+        # masked out downstream because its result never lands in a slot)
+        inj = x_microbatches[jnp.minimum(t, M - 1)]
+        x_in = jnp.where(idx == 0, inj, state)
+        y = stage_fn(stage_params, x_in)
+        # last device banks finished microbatch (slot = t − (L−1))
+        slot = t - (L - 1)
+        take = (idx == L - 1) & (slot >= 0) & (slot < M)
+        safe = jnp.clip(slot, 0, M - 1)
+        outs = outs.at[safe].set(jnp.where(take, y, outs[safe]))
+        state = lax.ppermute(y, axis_name, perm)
+        return state, outs
+
+    _, outs = lax.fori_loop(0, M + L - 1, tick, (state, outs))
+    # only the last stage holds real outputs; replicate
+    return lax.psum(jnp.where(idx == L - 1, outs, 0.0), axis_name)
+
+
+def pipeline_parallel_apply(mesh, stage_fn: Callable, stacked_params,
+                            x_microbatches, axis_name: str = "pp"):
+    """Jit-compiled pipeline over ``mesh``.
+
+    stacked_params: pytree whose leaves have a leading stage dim (L, ...)
+    — sharded one stage per device over ``axis_name``; x_microbatches
+    (M, ...) replicated.
+    """
+    fn = _build_pipeline(mesh, stage_fn, axis_name,
+                         jax_tree_structure(stacked_params))
+    return fn(stacked_params, x_microbatches)
+
+
+def jax_tree_structure(tree):
+    import jax
+
+    return jax.tree.structure(tree)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_pipeline(mesh, stage_fn, axis_name, params_treedef):
+    """Cached jitted pipeline — a fresh closure per call would defeat
+    jax.jit's cache and retrace/recompile every step."""
+    import jax
+
+    from .mesh import shard_map_fn
+
+    P = jax.sharding.PartitionSpec
+
+    def body(params, x):
+        import jax.numpy as jnp
+
+        local = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)
+        return pipeline_apply(stage_fn, local, x, axis_name)
+
+    spec_p = jax.tree.unflatten(
+        params_treedef, [P(axis_name)] * params_treedef.num_leaves)
+    fn = shard_map_fn()(body, mesh=mesh,
+                        in_specs=(spec_p, P()), out_specs=P())
+    return jax.jit(fn)

@@ -154,7 +154,10 @@ def sequence_parallel_attention(mesh, q, k, v, axis_name: str = "sp",
     runs under shard_map.
     """
     import jax
-    from jax.experimental.shard_map import shard_map
+
+    from .mesh import shard_map_fn
+
+    shard_map = shard_map_fn()
 
     P = jax.sharding.PartitionSpec
     spec = P(None, None, axis_name, None)

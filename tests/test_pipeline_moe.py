@@ -1,0 +1,118 @@
+"""Pipeline (pp) and expert (ep) parallelism — numeric contracts on the
+virtual 8-device CPU mesh (SURVEY.md §4 philosophy: sharded result ==
+single-device oracle)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu.parallel import build_mesh
+from incubator_mxnet_tpu.parallel.pipeline import pipeline_parallel_apply
+from incubator_mxnet_tpu.parallel.moe import expert_parallel_moe, moe_ffn
+
+
+def _stage_fn(params, x):
+    w, b = params
+    return jnp.tanh(x @ w + b)
+
+
+@pytest.mark.parametrize("L,M", [(4, 8), (8, 8), (2, 3)])
+def test_pipeline_matches_sequential(L, M):
+    rng = np.random.RandomState(0)
+    d = 16
+    mesh = build_mesh({"pp": L})
+    ws = jnp.asarray(rng.randn(L, d, d).astype(np.float32) * 0.3)
+    bs = jnp.asarray(rng.randn(L, d).astype(np.float32) * 0.1)
+    x = jnp.asarray(rng.randn(M, 4, d).astype(np.float32))
+
+    out = pipeline_parallel_apply(mesh, _stage_fn, (ws, bs), x)
+
+    ref = np.asarray(x)
+    for i in range(L):
+        ref = np.tanh(ref @ np.asarray(ws[i]) + np.asarray(bs[i]))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_differentiable():
+    """Grads flow through the ppermute schedule (training path)."""
+    rng = np.random.RandomState(1)
+    L, M, d = 4, 4, 8
+    mesh = build_mesh({"pp": L})
+    ws = jnp.asarray(rng.randn(L, d, d).astype(np.float32) * 0.3)
+    bs = jnp.zeros((L, d), jnp.float32)
+    x = jnp.asarray(rng.randn(M, 2, d).astype(np.float32))
+
+    def loss(ws, bs):
+        return jnp.sum(pipeline_parallel_apply(mesh, _stage_fn,
+                                               (ws, bs), x) ** 2)
+
+    def loss_ref(ws, bs):
+        y = x
+        for i in range(L):
+            y = jnp.tanh(y @ ws[i] + bs[i])
+        return jnp.sum(y ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1))(ws, bs)
+    gr = jax.grad(loss_ref, argnums=(0, 1))(ws, bs)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def _moe_oracle(x, gate_w, w1s, w2s):
+    """Dense single-device top-1 MoE reference."""
+    logits = x @ gate_w
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    expert = probs.argmax(-1)
+    out = np.zeros_like(x)
+    for t in range(x.shape[0]):
+        e = expert[t]
+        h = np.maximum(x[t] @ w1s[e], 0.0)
+        out[t] = (h @ w2s[e]) * probs[t, e]
+    return out
+
+
+@pytest.mark.parametrize("E", [4, 8])
+def test_moe_matches_dense(E):
+    rng = np.random.RandomState(2)
+    T, d, h = 8 * E, 16, 32  # T divisible by E (token sharding)
+    mesh = build_mesh({"ep": E})
+    x = rng.randn(T, d).astype(np.float32)
+    gate_w = rng.randn(d, E).astype(np.float32)
+    w1s = rng.randn(E, d, h).astype(np.float32) * 0.2
+    w2s = rng.randn(E, h, d).astype(np.float32) * 0.2
+
+    out = expert_parallel_moe(mesh, jnp.asarray(x), jnp.asarray(gate_w),
+                              jnp.asarray(w1s), jnp.asarray(w2s))
+    ref = _moe_oracle(x, gate_w, w1s, w2s)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_composes_with_dp():
+    """dp × ep on one mesh: batch shards over dp, experts over ep."""
+    from incubator_mxnet_tpu.parallel.mesh import shard_map_fn
+    shard_map = shard_map_fn()
+    import functools
+
+    rng = np.random.RandomState(3)
+    E, T, d, h = 4, 16, 8, 16
+    mesh = build_mesh({"dp": 2, "ep": E})
+    P = jax.sharding.PartitionSpec
+    x = rng.randn(2 * T, d).astype(np.float32)
+    gate_w = rng.randn(d, E).astype(np.float32)
+    w1s = rng.randn(E, d, h).astype(np.float32) * 0.2
+    w2s = rng.randn(E, h, d).astype(np.float32) * 0.2
+
+    def body(x, gw, w1, w2):
+        return moe_ffn(x, gw, jnp.squeeze(w1, 0), jnp.squeeze(w2, 0),
+                       "ep")
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(("dp", "ep")), P(), P("ep"), P("ep")),
+                   out_specs=P(("dp", "ep")))
+    out = jax.jit(fn)(jnp.asarray(x), jnp.asarray(gate_w),
+                      jnp.asarray(w1s), jnp.asarray(w2s))
+    ref = _moe_oracle(x, gate_w, w1s, w2s)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)

@@ -83,7 +83,8 @@ def test_ring_attention_grad_flows():
 def test_mixed_dp_sp_mesh():
     """sp composes with dp on one mesh — batch sharded on dp, sequence on
     sp — the long-context layout a real pod job uses."""
-    from jax.experimental.shard_map import shard_map
+    from incubator_mxnet_tpu.parallel.mesh import shard_map_fn
+    shard_map = shard_map_fn()
     import functools
 
     mesh = build_mesh({"dp": 2, "sp": 4})
