@@ -76,6 +76,10 @@ def pipeline_parallel_apply(mesh, stage_fn: Callable, stacked_params,
     stacked_params: pytree whose leaves have a leading stage dim (L, ...)
     — sharded one stage per device over ``axis_name``; x_microbatches
     (M, ...) replicated.
+
+    The jitted program is cached per (mesh, stage_fn, axis_name) — pass a
+    STABLE ``stage_fn`` (module-level function, not a fresh lambda per
+    call) or every call retraces and recompiles.
     """
     fn = _build_pipeline(mesh, stage_fn, axis_name,
                          jax_tree_structure(stacked_params))

@@ -168,3 +168,37 @@ def test_user_env_forwarded():
     cmds = launch.build_mpi_commands(2, 0, None, env, ["python", "t.py"],
                                      pass_keys=("OMP_NUM_THREADS",))
     assert "OMP_NUM_THREADS=4" in cmds[0][1]
+
+
+def test_sge_scripts():
+    """sge mode builds one qsub job-array script per role group with
+    SGE_TASK_ID-derived ranks (dmlc_tracker/sge.py pattern)."""
+    jobs = launch.plan_sge_jobs(4, 2, dict(BASE_ENV),
+                                ["python", "train.py"], queue="gpu.q")
+    roles = [r for r, _ in jobs]
+    assert roles == ["server", "worker"]
+    server, worker = jobs[0][1], jobs[1][1]
+    assert "#$ -t 1-2" in server and "#$ -t 1-4" in worker
+    assert "#$ -q gpu.q" in worker
+    assert "export TP_SERVER_ID=$((SGE_TASK_ID - 1))" in server
+    assert "export DMLC_WORKER_ID=$((SGE_TASK_ID - 1))" in worker
+    assert "export DMLC_ROLE=server" in server
+    assert "export DMLC_ROLE=worker" in worker
+    assert worker.rstrip().endswith("exec python train.py")
+
+
+def test_yarn_command():
+    """yarn mode submits through the dmlc-yarn AM jar with env args
+    (dmlc_tracker/yarn.py contract)."""
+    argv = launch.build_yarn_command(4, 2, dict(BASE_ENV),
+                                     ["python", "train.py"],
+                                     queue="prod")
+    assert argv[:3] == ["hadoop", "jar", "dmlc-yarn.jar"]
+    assert ["-num_workers", "4"] == argv[3:5]
+    assert ["-num_servers", "2"] == argv[5:7]
+    assert ["-queue", "prod"] == argv[7:9]
+    # rendezvous env forwarded; role left to the application master
+    joined = " ".join(argv)
+    assert "DMLC_PS_ROOT_URI" in joined
+    assert "DMLC_ROLE" not in joined
+    assert argv[-2:] == ["python", "train.py"]

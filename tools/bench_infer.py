@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Inference benchmark: ResNet-50 forward img/s (honest-fenced).
+
+Reference anchors (``docs/how_to/perf.md:118-148``, batch 32):
+K80 167.12, M40 373.35, **P100 713.17** img/s.  Prints one JSON line per
+batch size with ``vs_baseline`` against the P100 number.
+
+Env: TP_INFER_BATCHES (default "32,256"), TP_INFER_STEPS (default 30),
+TP_INFER_SMALL=1 for CPU smoke.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+P100_INFER = 713.17
+
+
+def main():
+    small = os.environ.get("TP_INFER_SMALL") == "1"
+    batches = [int(b) for b in os.environ.get(
+        "TP_INFER_BATCHES", "8" if small else "32,256").split(",")]
+    steps = int(os.environ.get("TP_INFER_STEPS", "3" if small else "30"))
+
+    import jax
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.parallel.fused import _lower_symbol
+
+    image = (3, 32, 32) if small else (3, 224, 224)
+    net = mx.models.resnet(num_layers=20 if small else 50,
+                           num_classes=10 if small else 1000,
+                           image_shape=image, layout="NHWC", stem="s2d",
+                           dtype="float32" if small else "bfloat16")
+    hwc = mx.models.image_data_shape(image, "NHWC")
+    shapes = {"data": (batches[0],) + hwc, "softmax_label": (batches[0],)}
+    arg_names = net.list_arguments()
+    aux_names = net.list_auxiliary_states()
+    arg_shapes, _, aux_shapes = net.infer_shape(**shapes)
+    shape_of = dict(zip(arg_names, arg_shapes))
+
+    rng = np.random.RandomState(0)
+    # f32 master params; the net casts to bf16 in-graph — the same
+    # configuration as the training bench (FusedTrainStep f32 masters)
+    params = {n: jax.device_put(
+        (rng.randn(*shape_of[n]) * 0.05).astype(np.float32))
+        for n in arg_names if n not in shapes}
+    aux = {n: jax.device_put(np.ones(s, np.float32) if n.endswith("var")
+                             else np.zeros(s, np.float32))
+           for n, s in zip(aux_names, aux_shapes)}
+    fwd = _lower_symbol(net, is_train=False)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def forward(params, aux, data):
+        args = dict(params)
+        args["data"] = data
+        args["softmax_label"] = jnp.zeros((data.shape[0],), jnp.float32)
+        outs, _ = fwd(args, aux, key)
+        # scalar that depends on every output row: the readback fence
+        return outs[0], jnp.sum(outs[0][:, 0])
+
+    for batch in batches:
+        data = jax.device_put(rng.rand(batch, *hwc).astype(np.float32))
+        _, fence = forward(params, aux, data)
+        float(np.asarray(fence))  # warm + drain
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            _, fence = forward(params, aux, data)
+        float(np.asarray(fence))  # true execution fence
+        dt = time.perf_counter() - t0
+        img_s = batch * steps / dt
+        print(json.dumps({
+            "metric": "resnet50_infer_imgs_per_sec",
+            "batch": batch,
+            "value": round(img_s, 2),
+            "unit": "img/s",
+            "vs_baseline": None if small
+            else round(img_s / P100_INFER, 3)}))
+
+
+if __name__ == "__main__":
+    main()

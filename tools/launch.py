@@ -12,7 +12,11 @@ Modes:
   like the dmlc ssh tracker; ``--sync-dst-dir`` rsyncs the working
   directory to every host first;
 - ``--launcher mpi -H hostfile``: one ``mpirun`` per role group with the
-  rendezvous env forwarded via ``-x`` (OpenMPI convention).
+  rendezvous env forwarded via ``-x`` (OpenMPI convention);
+- ``--launcher sge``: submit one ``qsub`` job array per role group
+  (dmlc_tracker/sge.py pattern: ``SGE_TASK_ID`` → rank);
+- ``--launcher yarn``: submit via the dmlc-yarn application master jar
+  (dmlc_tracker/yarn.py pattern; needs a hadoop/yarn install).
 
 Role contract in every mode: ``DMLC_ROLE`` ∈ {scheduler, server, worker};
 importing the framework in a server/scheduler process parks it in the
@@ -170,6 +174,120 @@ def build_mpi_commands(num_workers, num_servers, hostfile, base_env,
     return cmds
 
 
+def build_sge_script(role, n, env, command, queue=None):
+    """Job-array submission script for one role group (pure text —
+    unit-testable; dmlc_tracker/sge.py equivalent).  ``SGE_TASK_ID``
+    (1-based) supplies the per-task rank."""
+    rank_var = "DMLC_WORKER_ID" if role == "worker" else "TP_SERVER_ID"
+    lines = ["#!/bin/bash",
+             "#$ -S /bin/bash",
+             "#$ -cwd",
+             "#$ -t 1-%d" % n,
+             "#$ -N tp_%s" % role,
+             "#$ -j y"]
+    if queue:
+        lines.append("#$ -q %s" % queue)
+    for k, v in sorted(env.items()):
+        lines.append("export %s=%s" % (k, shlex.quote(str(v))))
+    lines.append("export %s=$((SGE_TASK_ID - 1))" % rank_var)
+    lines.append("exec " + " ".join(shlex.quote(c) for c in command))
+    return "\n".join(lines) + "\n"
+
+
+def plan_sge_jobs(num_workers, num_servers, base_env, command,
+                  queue=None, pass_keys=()):
+    """-> [(role, script_text)] for every role group (pure)."""
+    jobs = []
+    if num_servers > 0:
+        env = _remote_env(base_env, "server", {}, pass_keys)
+        jobs.append(("server", build_sge_script(
+            "server", num_servers, env, command, queue)))
+    env = _remote_env(base_env, "worker", {}, pass_keys)
+    jobs.append(("worker", build_sge_script(
+        "worker", num_workers, env, command, queue)))
+    return jobs
+
+
+def _require_ps_transport(args, mode):
+    """Grid modes can't pre-place the jax.distributed coordinator on an
+    unknown allocated node; only the PS transport (scheduler on the
+    launching host, which grid nodes can reach) is supported."""
+    if args.num_servers <= 0:
+        raise SystemExit(
+            "--launcher %s requires -s/--num-servers > 0: the collective "
+            "transport needs a coordinator on the rank-0 worker's host, "
+            "which a grid scheduler assigns only at run time" % mode)
+
+
+def submit_sge(args):
+    import re
+    import tempfile
+
+    _require_ps_transport(args, "sge")
+    base_env = _rendezvous_env(args, _local_ip())
+    group = _ProcGroup()
+    server_job = None
+    try:
+        env = dict(base_env)
+        env["DMLC_ROLE"] = "scheduler"
+        group.spawn("scheduler", args.command, env)
+        with tempfile.TemporaryDirectory() as d:
+            for role, script in plan_sge_jobs(
+                    args.num_workers, args.num_servers, base_env,
+                    args.command, args.queue, _user_env_keys(args)):
+                path = os.path.join(d, "%s.sh" % role)
+                with open(path, "w") as f:
+                    f.write(script)
+                if role == "worker":
+                    subprocess.check_call(["qsub", "-sync", "y", path])
+                else:
+                    out = subprocess.check_output(["qsub", path],
+                                                  text=True)
+                    m = re.search(r"job(?:-array)? (\d+)", out)
+                    server_job = m.group(1) if m else None
+        return 0
+    finally:
+        if server_job:
+            # servers park in the serving loop forever; reap the array
+            # like ssh/mpi terminate() reaps their server processes
+            subprocess.call(["qdel", server_job])
+        group.terminate()
+
+
+def build_yarn_command(num_workers, num_servers, env, command,
+                       am_jar="dmlc-yarn.jar", queue="default",
+                       pass_keys=()):
+    """``hadoop jar`` submission line for the dmlc-yarn application
+    master (dmlc_tracker/yarn.py contract; pure — unit-testable)."""
+    argv = ["hadoop", "jar", am_jar,
+            "-num_workers", str(num_workers),
+            "-num_servers", str(num_servers),
+            "-queue", queue]
+    full = _remote_env(env, "worker", {}, pass_keys)
+    full.pop("DMLC_ROLE", None)  # the AM assigns roles per container
+    for k, v in sorted(full.items()):
+        argv += ["-env", "%s=%s" % (k, v)]
+    return argv + list(command)
+
+
+def submit_yarn(args):
+    _require_ps_transport(args, "yarn")
+    base_env = _rendezvous_env(args, _local_ip())
+    group = _ProcGroup()
+    try:
+        env = dict(base_env)
+        env["DMLC_ROLE"] = "scheduler"
+        group.spawn("scheduler", args.command, env)
+        argv = build_yarn_command(args.num_workers, args.num_servers,
+                                  base_env, args.command,
+                                  queue=args.queue or "default",
+                                  pass_keys=_user_env_keys(args))
+        subprocess.check_call(argv)
+        return 0
+    finally:
+        group.terminate()
+
+
 def _rendezvous_env(args, root_uri):
     env = dict(os.environ)
     for kv in args.env:
@@ -323,10 +441,13 @@ def main():
                     help="rsync the working directory to this path on "
                          "every host before launching (ssh mode)")
     ap.add_argument("--launcher", default="local",
-                    choices=["local", "ssh", "mpi"],
+                    choices=["local", "ssh", "mpi", "sge", "yarn"],
                     help="local spawns everything on this machine; "
-                         "ssh/mpi fan out over -H hostfile (TPU pods "
+                         "ssh/mpi fan out over -H hostfile; sge/yarn "
+                         "submit to a grid scheduler (TPU pods "
                          "normally use k8s/slurm instead)")
+    ap.add_argument("-q", "--queue", type=str, default=None,
+                    help="grid queue name (sge/yarn)")
     ap.add_argument("--env", action="append", default=[],
                     help="extra KEY=VALUE env for all nodes")
     ap.add_argument("command", nargs=argparse.REMAINDER,
@@ -342,6 +463,10 @@ def main():
         return submit_ssh(args)
     if args.launcher == "mpi":
         return submit_mpi(args)
+    if args.launcher == "sge":
+        return submit_sge(args)
+    if args.launcher == "yarn":
+        return submit_yarn(args)
     return submit_local(args)
 
 
