@@ -499,6 +499,7 @@ class ImageRecordIter(DataIter):
         self._prefetch = max(1, int(prefetch_buffer))
         self._pool = None
         self._queue = None
+        self._worker = None
         self._stop = False
         self._start_prefetch()
 
@@ -523,8 +524,14 @@ class ImageRecordIter(DataIter):
         out = []
         for d in data:
             arr = d.asnumpy() if hasattr(d, "asnumpy") else np.asarray(d)
-            out.append(np.ascontiguousarray(
-                arr.transpose(2, 0, 1), dtype=self._dtype))
+            if self._dtype == np.uint8 and arr.dtype == np.uint8:
+                # uint8 stays HWC: the batch assembler does the CHW
+                # transpose for the whole batch at once (native C++ when
+                # available — iter_batchloader.h analog)
+                out.append(np.ascontiguousarray(arr))
+            else:
+                out.append(np.ascontiguousarray(
+                    arr.transpose(2, 0, 1), dtype=self._dtype))
         return label, out
 
     def _start_prefetch(self):
@@ -567,8 +574,22 @@ class ImageRecordIter(DataIter):
                         if inner.label_width > 1 else (bs,)
                     batch_label = np.zeros(label_shape,
                                            dtype=np.float32)
+                    imgs = [arr for _, arr in take]
+                    hwc = (self._dtype == np.uint8 and imgs
+                           and imgs[0].ndim == 3
+                           and imgs[0].shape[-1] == c)
+                    assembled = False
+                    if hwc:
+                        from . import native
+
+                        # whole-batch HWC→CHW transpose in the native
+                        # C++ assembler (GIL-free), numpy fallback below
+                        assembled = native.assemble_batch(imgs,
+                                                          batch_data)
                     for i, (label, arr) in enumerate(take):
-                        batch_data[i] = arr
+                        if not assembled:
+                            batch_data[i] = arr.transpose(2, 0, 1) \
+                                if hwc else arr
                         if inner.label_width > 1:
                             batch_label[i] = np.asarray(label)[
                                 :inner.label_width]
@@ -591,18 +612,25 @@ class ImageRecordIter(DataIter):
         self._worker = threading.Thread(target=worker, daemon=True)
         self._worker.start()
 
-    def reset(self):
+    def _drain_worker(self):
+        """Stop + drain until the prefetch worker exits (it could be
+        blocked on a full queue); shared by reset() and close()."""
         import queue
 
         self._stop = True
-        # drain until the worker exits so it cannot race the next epoch's
-        # worker on the shared inner iterator
+        if self._worker is None:
+            return
         while self._worker.is_alive():
             try:
                 self._queue.get(timeout=0.1)
             except queue.Empty:
                 pass
         self._worker.join()
+
+    def reset(self):
+        # drain so the dead epoch's worker cannot race the next epoch's
+        # worker on the shared inner iterator
+        self._drain_worker()
         self._inner.reset()
         self._start_prefetch()
 
@@ -621,6 +649,21 @@ class ImageRecordIter(DataIter):
         return batch
 
     __next__ = next
+
+    def close(self):
+        """Stop the prefetch worker and tear down the decode pool
+        deterministically (a GC'd ThreadPool raises noisy errors at
+        interpreter shutdown)."""
+        self._drain_worker()
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def ImageRecordUInt8Iter(*args, **kwargs):

@@ -14,6 +14,7 @@ from .inception_bn import get_symbol as inception_bn
 from .lstm_ptb import get_symbol as lstm_ptb, lstm_ptb_sym_gen
 from .ssd import ssd_300, get_symbol_train as ssd_train, \
     get_symbol as ssd_deploy
+from . import rcnn
 
 __all__ = ["lenet", "mlp", "alexnet", "resnet", "vgg", "inception_bn",
            "lstm_ptb", "lstm_ptb_sym_gen", "ssd_300", "ssd_train",

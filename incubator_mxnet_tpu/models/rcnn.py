@@ -1,0 +1,302 @@
+"""Faster R-CNN (VGG16 backbone) — reference
+``example/rcnn/rcnn/symbol/symbol_vgg.py`` (get_vgg_train :333,
+get_vgg_test :263, get_vgg_rpn :178) and the python ``proposal_target``
+custom op (``rcnn/io/rcnn.py`` sample_rois).
+
+The RPN + Fast R-CNN head composition is symbol-level and uses the
+framework's static-shape `_contrib_Proposal` / `ROIPooling` ops; the
+train-time ROI sampler runs as a host CustomOp exactly like the
+reference's default python path (``mx.symbol.Custom(op_type=
+'proposal_target')``) — sampling is data-dependent control flow that
+belongs on the host, not in XLA.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import operator as op_mod
+from .. import symbol as sym
+
+NUM_ANCHORS = 9
+
+
+def _vgg_conv(data):
+    """VGG16 shared conv body (conv1_1..relu5_3, reference
+    get_vgg_conv)."""
+    x = data
+    cfg = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+    for block, (n, filt) in enumerate(cfg, start=1):
+        for layer in range(1, n + 1):
+            x = sym.Convolution(x, kernel=(3, 3), pad=(1, 1),
+                                num_filter=filt,
+                                name="conv%d_%d" % (block, layer))
+            x = sym.Activation(x, act_type="relu",
+                               name="relu%d_%d" % (block, layer))
+        if block < 5:  # stride 16 total: conv5 is NOT followed by pool
+            x = sym.Pooling(x, pool_type="max", kernel=(2, 2),
+                            stride=(2, 2), name="pool%d" % block)
+    return x
+
+
+def _rpn_head(body, num_anchors):
+    rpn_conv = sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
+                               num_filter=512, name="rpn_conv_3x3")
+    rpn_relu = sym.Activation(rpn_conv, act_type="relu", name="rpn_relu")
+    cls_score = sym.Convolution(rpn_relu, kernel=(1, 1), pad=(0, 0),
+                                num_filter=2 * num_anchors,
+                                name="rpn_cls_score")
+    bbox_pred = sym.Convolution(rpn_relu, kernel=(1, 1), pad=(0, 0),
+                                num_filter=4 * num_anchors,
+                                name="rpn_bbox_pred")
+    return cls_score, bbox_pred
+
+
+def _fast_rcnn_head(body, rois, num_classes, feat_stride):
+    pool5 = sym.ROIPooling(body, rois, name="roi_pool5",
+                           pooled_size=(7, 7),
+                           spatial_scale=1.0 / feat_stride)
+    flat = sym.Flatten(pool5, name="flatten")
+    fc6 = sym.FullyConnected(flat, num_hidden=4096, name="fc6")
+    relu6 = sym.Activation(fc6, act_type="relu", name="relu6")
+    drop6 = sym.Dropout(relu6, p=0.5, name="drop6")
+    fc7 = sym.FullyConnected(drop6, num_hidden=4096, name="fc7")
+    relu7 = sym.Activation(fc7, act_type="relu", name="relu7")
+    drop7 = sym.Dropout(relu7, p=0.5, name="drop7")
+    cls_score = sym.FullyConnected(drop7, num_hidden=num_classes,
+                                   name="cls_score")
+    bbox_pred = sym.FullyConnected(drop7, num_hidden=num_classes * 4,
+                                   name="bbox_pred")
+    return cls_score, bbox_pred
+
+
+def _bbox_transform(ex, gt):
+    """Box → regression-target parameterization (reference
+    ``rcnn/processing/bbox_regression.py``)."""
+    ew = ex[:, 2] - ex[:, 0] + 1.0
+    eh = ex[:, 3] - ex[:, 1] + 1.0
+    ecx = ex[:, 0] + 0.5 * (ew - 1.0)
+    ecy = ex[:, 1] + 0.5 * (eh - 1.0)
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * (gw - 1.0)
+    gcy = gt[:, 1] + 0.5 * (gh - 1.0)
+    return np.stack([(gcx - ecx) / (ew + 1e-14),
+                     (gcy - ecy) / (eh + 1e-14),
+                     np.log(gw / ew), np.log(gh / eh)], axis=1)
+
+
+def _overlaps(boxes, gt):
+    """IoU matrix (N, M)."""
+    ab = ((boxes[:, 2] - boxes[:, 0] + 1)
+          * (boxes[:, 3] - boxes[:, 1] + 1))[:, None]
+    ag = ((gt[:, 2] - gt[:, 0] + 1) * (gt[:, 3] - gt[:, 1] + 1))[None, :]
+    iw = np.maximum(0, np.minimum(boxes[:, 2:3], gt[None, :, 2])
+                    - np.maximum(boxes[:, 0:1], gt[None, :, 0]) + 1)
+    ih = np.maximum(0, np.minimum(boxes[:, 3:4], gt[None, :, 3])
+                    - np.maximum(boxes[:, 1:2], gt[None, :, 1]) + 1)
+    inter = iw * ih
+    return inter / (ab + ag - inter + 1e-14)
+
+
+class ProposalTargetOp(op_mod.CustomOp):
+    """Sample proposals into fixed-size ROI batches with labels and
+    class-specific bbox targets (reference sample_rois)."""
+
+    def __init__(self, num_classes, batch_rois, fg_fraction, fg_overlap):
+        super().__init__()
+        self.num_classes = num_classes
+        self.batch_rois = batch_rois
+        self.fg_rois = int(round(batch_rois * fg_fraction))
+        self.fg_overlap = fg_overlap
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        rois = np.asarray(in_data[0]).reshape(-1, 5)
+        gt = np.asarray(in_data[1]).reshape(-1, 5)
+        gt = gt[gt[:, 4] >= 0]  # -1-padded invalid rows
+        n = self.batch_rois
+        # include gt boxes as proposals (reference appends them)
+        if len(gt):
+            gt_rois = np.concatenate(
+                [np.zeros((len(gt), 1), np.float32),
+                 gt[:, :4].astype(np.float32)], axis=1)
+            rois = np.concatenate([rois, gt_rois], axis=0)
+        if len(gt):
+            ov = _overlaps(rois[:, 1:5], gt[:, :4])
+            gt_assign = ov.argmax(axis=1)
+            max_ov = ov.max(axis=1)
+        else:
+            gt_assign = np.zeros(len(rois), np.int64)
+            max_ov = np.zeros(len(rois), np.float32)
+
+        fg = np.where(max_ov >= self.fg_overlap)[0]
+        bg = np.where(max_ov < self.fg_overlap)[0]
+        n_fg = min(self.fg_rois, len(fg))
+        if len(fg) > n_fg:
+            fg = np.random.choice(fg, n_fg, replace=False)
+        else:
+            fg = fg[:n_fg]
+        n_bg = n - n_fg
+        if len(bg) > 0:
+            bg = np.random.choice(bg, n_bg, replace=len(bg) < n_bg)
+            keep = np.concatenate([fg, bg])
+        else:
+            # every proposal is foreground: pad with foregrounds KEEPING
+            # their labels — padding them as background would teach the
+            # classifier that true object crops are background
+            extra = np.random.choice(
+                np.where(max_ov >= self.fg_overlap)[0], n_bg,
+                replace=True)
+            keep = np.concatenate([fg, extra])
+            n_fg = n
+
+        out_rois = rois[keep].astype(np.float32)
+        labels = np.zeros(n, np.float32)
+        targets = np.zeros((n, 4 * self.num_classes), np.float32)
+        weights = np.zeros((n, 4 * self.num_classes), np.float32)
+        if len(gt) and n_fg:
+            cls = gt[gt_assign[keep[:n_fg]], 4].astype(np.int64)
+            labels[:n_fg] = cls
+            t = _bbox_transform(out_rois[:n_fg, 1:5],
+                                gt[gt_assign[keep[:n_fg]], :4])
+            for i, c in enumerate(cls):
+                targets[i, 4 * c:4 * c + 4] = t[i]
+                weights[i, 4 * c:4 * c + 4] = 1.0
+        self.assign(out_data[0], req[0], out_rois)
+        self.assign(out_data[1], req[1], labels)
+        self.assign(out_data[2], req[2], targets)
+        self.assign(out_data[3], req[3], weights)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for i in range(len(in_grad)):
+            self.assign(in_grad[i], req[i], 0)
+
+
+@op_mod.register("proposal_target")
+class ProposalTargetProp(op_mod.CustomOpProp):
+    def __init__(self, num_classes, batch_images="1", batch_rois="128",
+                 fg_fraction="0.25", fg_overlap="0.5"):
+        super().__init__(need_top_grad=False)
+        self.num_classes = int(num_classes)
+        self.batch_rois = int(batch_rois)
+        self.fg_fraction = float(fg_fraction)
+        self.fg_overlap = float(fg_overlap)
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["rois_output", "label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        n = self.batch_rois
+        return in_shape, [(n, 5), (n,), (n, 4 * self.num_classes),
+                          (n, 4 * self.num_classes)], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return ProposalTargetOp(self.num_classes, self.batch_rois,
+                                self.fg_fraction, self.fg_overlap)
+
+
+def _proposal(cls_score_reshape, bbox_pred, im_info, num_anchors,
+              feat_stride, pre_nms, post_nms, name="rois"):
+    act = sym.SoftmaxActivation(cls_score_reshape, mode="channel",
+                                name="rpn_cls_act")
+    act_reshape = sym.Reshape(act, shape=(0, 2 * num_anchors, -1, 0),
+                              name="rpn_cls_act_reshape")
+    return getattr(sym, "_contrib_Proposal")(
+        act_reshape, bbox_pred, im_info, name=name,
+        feature_stride=feat_stride, scales=(8, 16, 32),
+        ratios=(0.5, 1, 2), rpn_pre_nms_top_n=pre_nms,
+        rpn_post_nms_top_n=post_nms, threshold=0.7, rpn_min_size=16)
+
+
+def get_symbol_train(num_classes=21, num_anchors=NUM_ANCHORS,
+                     feat_stride=16, batch_rois=128,
+                     rpn_batch_size=256, pre_nms=6000, post_nms=300):
+    """End-to-end Faster R-CNN training net (get_vgg_train :333)."""
+    data = sym.Variable("data")
+    im_info = sym.Variable("im_info")
+    gt_boxes = sym.Variable("gt_boxes")
+    rpn_label = sym.Variable("label")
+    rpn_bbox_target = sym.Variable("bbox_target")
+    rpn_bbox_weight = sym.Variable("bbox_weight")
+
+    body = _vgg_conv(data)
+    rpn_cls_score, rpn_bbox_pred = _rpn_head(body, num_anchors)
+
+    score_reshape = sym.Reshape(rpn_cls_score, shape=(0, 2, -1, 0),
+                                name="rpn_cls_score_reshape")
+    rpn_cls_prob = sym.SoftmaxOutput(
+        score_reshape, rpn_label, multi_output=True,
+        normalization="valid", use_ignore=True, ignore_label=-1,
+        name="rpn_cls_prob")
+    rpn_bbox_loss_ = rpn_bbox_weight * sym.smooth_l1(
+        rpn_bbox_pred - rpn_bbox_target, scalar=3.0,
+        name="rpn_bbox_loss_")
+    rpn_bbox_loss = sym.MakeLoss(rpn_bbox_loss_, name="rpn_bbox_loss",
+                                 grad_scale=1.0 / rpn_batch_size)
+
+    rois = _proposal(score_reshape, rpn_bbox_pred, im_info, num_anchors,
+                     feat_stride, pre_nms, post_nms)
+    gt_reshape = sym.Reshape(gt_boxes, shape=(-1, 5),
+                             name="gt_boxes_reshape")
+    group = sym.Custom(rois, gt_reshape, op_type="proposal_target",
+                       num_classes=num_classes, batch_rois=batch_rois,
+                       name="proposal_target")
+    rois, label, bbox_target, bbox_weight = \
+        group[0], group[1], group[2], group[3]
+
+    cls_score, bbox_pred = _fast_rcnn_head(body, rois, num_classes,
+                                           feat_stride)
+    cls_prob = sym.SoftmaxOutput(cls_score, label,
+                                 normalization="batch", name="cls_prob")
+    bbox_loss_ = bbox_weight * sym.smooth_l1(
+        bbox_pred - bbox_target, scalar=1.0, name="bbox_loss_")
+    bbox_loss = sym.MakeLoss(bbox_loss_, name="bbox_loss",
+                             grad_scale=1.0 / batch_rois)
+    return sym.Group([rpn_cls_prob, rpn_bbox_loss, cls_prob, bbox_loss])
+
+
+def get_symbol_test(num_classes=21, num_anchors=NUM_ANCHORS,
+                    feat_stride=16, pre_nms=6000, post_nms=300,
+                    batch_images=1):
+    """Faster R-CNN inference net (get_vgg_test :263)."""
+    data = sym.Variable("data")
+    im_info = sym.Variable("im_info")
+    body = _vgg_conv(data)
+    rpn_cls_score, rpn_bbox_pred = _rpn_head(body, num_anchors)
+    score_reshape = sym.Reshape(rpn_cls_score, shape=(0, 2, -1, 0),
+                                name="rpn_cls_score_reshape")
+    rois = _proposal(score_reshape, rpn_bbox_pred, im_info, num_anchors,
+                     feat_stride, pre_nms, post_nms)
+    cls_score, bbox_pred = _fast_rcnn_head(body, rois, num_classes,
+                                           feat_stride)
+    cls_prob = sym.softmax(cls_score, name="cls_prob")
+    cls_prob = sym.Reshape(cls_prob,
+                           shape=(batch_images, -1, num_classes),
+                           name="cls_prob_reshape")
+    bbox_pred = sym.Reshape(bbox_pred,
+                            shape=(batch_images, -1, 4 * num_classes),
+                            name="bbox_pred_reshape")
+    return sym.Group([rois, cls_prob, bbox_pred])
+
+
+def get_symbol_rpn(num_anchors=NUM_ANCHORS, rpn_batch_size=256):
+    """Stand-alone RPN training net (get_vgg_rpn :178)."""
+    data = sym.Variable("data")
+    rpn_label = sym.Variable("label")
+    rpn_bbox_target = sym.Variable("bbox_target")
+    rpn_bbox_weight = sym.Variable("bbox_weight")
+    body = _vgg_conv(data)
+    cls_score, bbox_pred = _rpn_head(body, num_anchors)
+    score_reshape = sym.Reshape(cls_score, shape=(0, 2, -1, 0),
+                                name="rpn_cls_score_reshape")
+    cls_prob = sym.SoftmaxOutput(
+        score_reshape, rpn_label, multi_output=True,
+        normalization="valid", use_ignore=True, ignore_label=-1,
+        name="rpn_cls_prob")
+    bbox_loss_ = rpn_bbox_weight * sym.smooth_l1(
+        bbox_pred - rpn_bbox_target, scalar=3.0, name="rpn_bbox_loss_")
+    bbox_loss = sym.MakeLoss(bbox_loss_, name="rpn_bbox_loss",
+                             grad_scale=1.0 / rpn_batch_size)
+    return sym.Group([cls_prob, bbox_loss])

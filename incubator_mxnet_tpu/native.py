@@ -1,0 +1,132 @@
+"""ctypes loader for the native (C++) runtime pieces.
+
+The reference's recordio reader and batch loader are C++
+(``dmlc-core/src/recordio.cc``, ``src/io/iter_batchloader.h``); here the
+same pieces live in ``native/recordio_native.cc``, compiled on demand
+with the host toolchain (pybind11 is not available in this image, so the
+binding is a plain C ABI over ctypes — ctypes releases the GIL around
+foreign calls, so pool threads overlap in the C code).
+
+``lib()`` returns the loaded library or None (no toolchain, build
+failure) — callers keep a pure-python fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(_HERE), "native",
+                    "recordio_native.cc")
+_SO = os.path.join(_HERE, "_native.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return True
+    try:
+        subprocess.check_call(
+            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+             "-o", _SO, _SRC],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The native library, built+loaded lazily; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        try:
+            cdll = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        LL = ctypes.c_longlong
+        cdll.tp_recordio_scan.restype = LL
+        cdll.tp_recordio_scan.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(LL), ctypes.POINTER(LL), LL]
+        PP = ctypes.POINTER(ctypes.c_char_p)
+        cdll.tp_assemble_chw_u8.restype = None
+        cdll.tp_assemble_chw_u8.argtypes = [
+            PP, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p]
+        cdll.tp_assemble_chw_f32.restype = None
+        cdll.tp_assemble_chw_f32.argtypes = [
+            PP, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p]
+        _lib = cdll
+        return _lib
+
+
+def recordio_scan(path: str):
+    """-> (offsets, lengths) int64 arrays for every record in a .rec
+    file, or None if the native library is unavailable."""
+    cdll = lib()
+    if cdll is None:
+        return None
+    LL = ctypes.c_longlong
+    cap = 1 << 16
+    while True:
+        offs = np.empty(cap, np.int64)
+        lens = np.empty(cap, np.int64)
+        n = cdll.tp_recordio_scan(
+            path.encode(), offs.ctypes.data_as(ctypes.POINTER(LL)),
+            lens.ctypes.data_as(ctypes.POINTER(LL)), cap)
+        if n < 0:
+            raise IOError("malformed recordio file %s" % path)
+        if n <= cap:
+            return offs[:n].copy(), lens[:n].copy()
+        cap = int(n)
+
+
+def assemble_batch(images, out: np.ndarray, mean=None, std=None) -> bool:
+    """Transpose a list of HWC uint8 images into the CHW batch ``out``
+    (uint8 or float32, with optional f32 mean/std normalize).  Returns
+    False (caller falls back to numpy) if the native library is missing
+    or shapes do not qualify."""
+    cdll = lib()
+    if cdll is None or not images:
+        return False
+    h, w, c = images[0].shape
+    if out.shape[1:] != (c, h, w) or out.shape[0] < len(images):
+        return False
+    for im in images:
+        if im.shape != (h, w, c) or im.dtype != np.uint8 \
+                or not im.flags.c_contiguous:
+            return False
+    ptrs = (ctypes.c_char_p * len(images))(
+        *[im.ctypes.data_as(ctypes.c_char_p) for im in images])
+    if out.dtype == np.uint8:
+        cdll.tp_assemble_chw_u8(ptrs, len(images), h, w, c,
+                                out.ctypes.data)
+        return True
+    if out.dtype == np.float32:
+        m = np.ascontiguousarray(mean, np.float32) \
+            if mean is not None else None
+        s = np.ascontiguousarray(1.0 / np.asarray(std, np.float32)) \
+            if std is not None else None
+        cdll.tp_assemble_chw_f32(
+            ptrs, len(images), h, w, c,
+            m.ctypes.data if m is not None else None,
+            s.ctypes.data if s is not None else None,
+            out.ctypes.data)
+        return True
+    return False

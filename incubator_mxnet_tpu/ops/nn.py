@@ -338,9 +338,18 @@ def _softmax_output_fn(grad_scale, ignore_label, use_ignore, multi_output,
     def f_bwd(res, g):
         out, label = res
         if multi_output:
-            # data (N, C, d...) label (N, d...)
+            # data (N, C, d...) label (N, d...) — the reference also
+            # accepts a size-matched FLAT label, e.g. the RPN feeds
+            # (N, A·H·W) against scores (N, 2, A·H/2·W... ) shaped
+            # (N, 2, d1, d2) (softmax_output-inl.h flattens to
+            # (n, c, rest) internally)
             nclass = out.shape[1]
-            lab = label.astype(jnp.int32)
+            spatial = out.shape[:1] + out.shape[2:]
+            lab = label
+            if lab.shape != spatial and \
+                    int(np.prod(lab.shape)) == int(np.prod(spatial)):
+                lab = lab.reshape(spatial)
+            lab = lab.astype(jnp.int32)
             oh = jnp.moveaxis(jax.nn.one_hot(lab, nclass, dtype=out.dtype),
                               -1, 1)
             grad = out - oh

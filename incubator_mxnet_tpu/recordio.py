@@ -17,7 +17,7 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["MXRecordIO", "IndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+           "pack_img", "unpack_img", "scan_record_starts"]
 
 _MAGIC = 0xced7230a
 IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
@@ -113,6 +113,20 @@ class IndexedRecordIO(MXRecordIO):
         self.keys = []
         if self.writable:
             self.fidx = open(self.idx_path, "w")
+        elif not os.path.exists(self.idx_path):
+            # no .idx sidecar: rebuild the index by scanning the record
+            # framing (native C++ scanner when available — the reference
+            # reader was C++ dmlc-core recordio).  Cached: reset() runs
+            # close()+open() every epoch and the file cannot change.
+            self.fidx = None
+            cached = getattr(self, "_scan_cache", None)
+            if cached is None:
+                cached = scan_record_starts(self.uri)
+                self._scan_cache = cached
+            for i, pos in enumerate(cached):
+                key = self.key_type(i)
+                self.idx[key] = pos
+                self.keys.append(key)
         else:
             self.fidx = open(self.idx_path, "r")
             for line in self.fidx:
@@ -218,3 +232,32 @@ def _decode_img(buf: bytes) -> np.ndarray:
     from PIL import Image
 
     return np.asarray(Image.open(_io.BytesIO(buf)))
+
+
+def scan_record_starts(uri: str):
+    """Record START offsets (header position) for every record in a
+    ``.rec`` file — native C++ scanner when available, python framing
+    walk otherwise."""
+    from . import native
+
+    scanned = native.recordio_scan(uri)
+    if scanned is not None:
+        offsets, _ = scanned
+        return [int(o) - 8 for o in offsets]  # payload → header start
+    starts = []
+    with open(uri, "rb") as f:
+        while True:
+            pos = f.tell()
+            head = f.read(8)
+            if len(head) < 8:
+                break
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _MAGIC:
+                raise MXNetError("malformed recordio file %s" % uri)
+            starts.append(pos)
+            # upper 3 bits of the length word are the continue flag
+            # (dmlc recordio framing) — mask exactly like read()
+            length = lrec & ((1 << 29) - 1)
+            pad = (4 - length % 4) % 4
+            f.seek(length + pad, os.SEEK_CUR)
+    return starts

@@ -1,0 +1,99 @@
+// Native runtime pieces for the TPU-native framework's host data path.
+//
+// Reference analog: dmlc-core's recordio reader + the C++ batch loader of
+// iter_image_recordio_2.cc — the parts of the reference IO stack that were
+// native C++ and stay native here.  Exposed over a plain C ABI and loaded
+// through ctypes (no pybind11 in this image); every entry point releases
+// no Python state, so callers may invoke from pool threads without the
+// GIL (ctypes drops it around foreign calls).
+//
+// Build: see native/Makefile (g++ -O2 -shared -fPIC).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+}  // namespace
+
+extern "C" {
+
+// Scan a .rec file and collect (payload_offset, payload_length) pairs.
+// Returns the number of records found, or -1 on malformed framing /
+// unreadable file.  offsets/lengths hold up to `cap` entries; extra
+// records are counted but not stored (call again with a bigger buffer).
+long long tp_recordio_scan(const char* path, long long* offsets,
+                           long long* lengths, long long cap) {
+  FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) return -1;
+  long long n = 0;
+  uint32_t head[2];
+  for (;;) {
+    size_t got = std::fread(head, sizeof(uint32_t), 2, f);
+    if (got == 0) break;  // clean EOF
+    if (got != 2 || head[0] != kMagic) {
+      std::fclose(f);
+      return -1;
+    }
+    // upper 3 bits of the length word are the continue flag
+    long long len = static_cast<long long>(head[1] & ((1u << 29) - 1));
+    long long pos = std::ftell(f);
+    if (n < cap) {
+      offsets[n] = pos;
+      lengths[n] = len;
+    }
+    ++n;
+    long long pad = (4 - (len % 4)) % 4;
+    if (std::fseek(f, len + pad, SEEK_CUR) != 0) {
+      std::fclose(f);
+      return -1;
+    }
+  }
+  std::fclose(f);
+  return n;
+}
+
+// Assemble a batch: for each of n images, transpose an HWC uint8 buffer
+// (h*w*c contiguous) into the CHW slot i of `out` (n*c*h*w).  The inner
+// transpose is the per-image copy the reference batch loader did in C++
+// (iter_batchloader.h) — GIL-free here so decode-pool threads overlap.
+void tp_assemble_chw_u8(const uint8_t** imgs, int64_t n, int64_t h,
+                        int64_t w, int64_t c, uint8_t* out) {
+  const int64_t plane = h * w;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* src = imgs[i];
+    uint8_t* dst = out + i * c * plane;
+    for (int64_t p = 0; p < plane; ++p) {
+      const uint8_t* px = src + p * c;
+      for (int64_t ch = 0; ch < c; ++ch) {
+        dst[ch * plane + p] = px[ch];
+      }
+    }
+  }
+}
+
+// Same, float32 output with optional per-channel mean/std normalize
+// (mean/std may be null).
+void tp_assemble_chw_f32(const uint8_t** imgs, int64_t n, int64_t h,
+                         int64_t w, int64_t c, const float* mean,
+                         const float* inv_std, float* out) {
+  const int64_t plane = h * w;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* src = imgs[i];
+    float* dst = out + i * c * plane;
+    for (int64_t ch = 0; ch < c; ++ch) {
+      const float m = mean ? mean[ch] : 0.0f;
+      const float s = inv_std ? inv_std[ch] : 1.0f;
+      float* d = dst + ch * plane;
+      const uint8_t* sp = src + ch;
+      for (int64_t p = 0; p < plane; ++p) {
+        d[p] = (static_cast<float>(sp[p * c]) - m) * s;
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,100 @@
+"""Native (C++) runtime pieces: recordio scanner + batch assembler.
+
+These are the host-side components the reference kept in C++
+(dmlc-core recordio, ``iter_batchloader.h``); built on demand with g++
+and bound over ctypes, with pure-python fallbacks everywhere.
+"""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import native, recordio
+
+HAVE_GXX = shutil.which("g++") is not None
+
+
+def _write_rec(path, payloads):
+    rec = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        rec.write(p)
+    rec.close()
+
+
+@pytest.mark.skipif(not HAVE_GXX, reason="no C++ toolchain")
+def test_native_builds():
+    assert native.lib() is not None
+
+
+@pytest.mark.skipif(not HAVE_GXX, reason="no C++ toolchain")
+def test_native_scan_matches_python(tmp_path):
+    path = str(tmp_path / "a.rec")
+    payloads = [b"x" * n for n in (1, 3, 4, 1000, 7)]
+    _write_rec(path, payloads)
+
+    offs, lens = native.recordio_scan(path)
+    assert list(lens) == [len(p) for p in payloads]
+    # native payload offsets − 8 == python header starts
+    starts = recordio.scan_record_starts(path)
+    assert [int(o) - 8 for o in offs] == starts
+    # offsets address the actual payloads
+    with open(path, "rb") as f:
+        for o, p in zip(offs, payloads):
+            f.seek(int(o))
+            assert f.read(len(p)) == p
+
+
+def test_scan_record_starts_python_fallback(tmp_path, monkeypatch):
+    monkeypatch.setattr(native, "recordio_scan", lambda path: None)
+    path = str(tmp_path / "b.rec")
+    payloads = [b"abc", b"defghij"]
+    _write_rec(path, payloads)
+    starts = recordio.scan_record_starts(path)
+    rec = recordio.MXRecordIO(path, "r")
+    for s, p in zip(starts, payloads):
+        rec.fp.seek(s)
+        assert rec.read() == p
+
+
+def test_indexed_recordio_without_idx(tmp_path):
+    """A .rec with no .idx sidecar is still randomly addressable — the
+    index is rebuilt by scanning the framing."""
+    path = str(tmp_path / "c.rec")
+    w = recordio.IndexedRecordIO(str(tmp_path / "c.idx"), path, "w")
+    for i in range(5):
+        w.write_idx(i, b"payload-%d" % i)
+    w.close()
+    os.remove(str(tmp_path / "c.idx"))
+
+    r = recordio.IndexedRecordIO(str(tmp_path / "c.idx"), path, "r")
+    assert r.keys == [0, 1, 2, 3, 4]
+    assert r.read_idx(3) == b"payload-3"
+    assert r.read_idx(0) == b"payload-0"
+
+
+@pytest.mark.skipif(not HAVE_GXX, reason="no C++ toolchain")
+def test_assemble_batch_u8_and_f32():
+    rng = np.random.RandomState(0)
+    n, h, w, c = 5, 6, 7, 3
+    imgs = [rng.randint(0, 255, (h, w, c)).astype(np.uint8)
+            for _ in range(n)]
+    ref = np.stack([im.transpose(2, 0, 1) for im in imgs])
+
+    out8 = np.zeros((n, c, h, w), np.uint8)
+    assert native.assemble_batch(imgs, out8)
+    np.testing.assert_array_equal(out8, ref)
+
+    mean = np.array([10.0, 20.0, 30.0], np.float32)
+    std = np.array([2.0, 4.0, 8.0], np.float32)
+    outf = np.zeros((n, c, h, w), np.float32)
+    assert native.assemble_batch(imgs, outf, mean=mean, std=std)
+    expect = (ref.astype(np.float32)
+              - mean.reshape(1, 3, 1, 1)) / std.reshape(1, 3, 1, 1)
+    np.testing.assert_allclose(outf, expect, rtol=1e-6)
+
+    # shape/dtype mismatches refuse cleanly (caller falls back)
+    assert not native.assemble_batch(imgs, np.zeros((n, c, h, w),
+                                                    np.float64))
+    assert not native.assemble_batch(
+        [i.astype(np.float32) for i in imgs], out8)

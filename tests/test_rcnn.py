@@ -1,0 +1,123 @@
+"""Faster R-CNN model family — reference
+``example/rcnn/rcnn/symbol/symbol_vgg.py`` parity at the symbol level:
+shape inference, test-net forward, proposal_target sampling, and a
+train-net forward/backward step."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.models import rcnn
+
+
+def test_symbol_shapes():
+    net = rcnn.get_symbol_test(num_classes=4, post_nms=50)
+    _, outs, _ = net.infer_shape(data=(1, 3, 64, 64), im_info=(1, 3))
+    assert outs == [(50, 5), (1, 50, 4), (1, 50, 16)]
+
+    rpn = rcnn.get_symbol_rpn()
+    args = rpn.list_arguments()
+    assert "rpn_cls_score_weight" in args and "data" in args
+
+
+def test_proposal_target_sampling():
+    """The host sampler produces fixed-size ROI batches with
+    class-specific targets (reference sample_rois semantics)."""
+    np.random.seed(0)
+    prop = rcnn.ProposalTargetProp(num_classes="3", batch_rois="8",
+                                   fg_fraction="0.5")
+    op = prop.create_operator(None, None, None)
+    rois = np.array([[0, 0, 0, 10, 10],
+                     [0, 20, 20, 40, 40],
+                     [0, 1, 1, 11, 11],
+                     [0, 50, 50, 60, 60]], np.float32)
+    gt = np.array([[0, 0, 10, 10, 1],      # class 1
+                   [20, 20, 40, 40, 2],    # class 2
+                   [-1, -1, -1, -1, -1]],  # pad row: ignored
+                  np.float32)
+    out = [np.zeros((8, 5), np.float32), np.zeros(8, np.float32),
+           np.zeros((8, 12), np.float32), np.zeros((8, 12), np.float32)]
+    op.forward(True, ["write"] * 4, [rois, gt], out, [])
+    out_rois, labels, targets, weights = out
+    assert out_rois.shape == (8, 5)
+    # foregrounds carry their gt class; gt boxes were appended so exact
+    # matches exist
+    assert set(labels) <= {0.0, 1.0, 2.0}
+    assert (labels > 0).sum() >= 2
+    for i in range(8):
+        c = int(labels[i])
+        if c > 0:
+            assert weights[i, 4 * c:4 * c + 4].all()
+            assert not weights[i, :4].any()
+        else:
+            assert not weights[i].any()
+    # an exact-match roi has ~zero regression target
+    exact = np.where(labels == 1)[0]
+    if len(exact):
+        i = exact[0]
+        if np.allclose(out_rois[i, 1:], [0, 0, 10, 10]):
+            assert np.abs(targets[i, 4:8]).max() < 1e-5
+
+
+@pytest.mark.slow
+def test_rcnn_test_net_forward():
+    net = rcnn.get_symbol_test(num_classes=3, post_nms=20, pre_nms=200)
+    ex = net.simple_bind(grad_req="null", data=(1, 3, 64, 64),
+                         im_info=(1, 3))
+    rng = np.random.RandomState(0)
+    for n in ex.arg_dict:
+        if n not in ("data", "im_info"):
+            ex.arg_dict[n][:] = mx.nd.array(
+                rng.uniform(-0.01, 0.01,
+                            ex.arg_dict[n].shape).astype(np.float32))
+    ex.arg_dict["data"][:] = mx.nd.array(
+        rng.rand(1, 3, 64, 64).astype(np.float32))
+    ex.arg_dict["im_info"][:] = mx.nd.array(
+        np.array([[64, 64, 1.0]], np.float32))
+    rois, cls_prob, bbox = [o.asnumpy() for o in
+                            ex.forward(is_train=False)]
+    assert rois.shape == (20, 5)
+    assert cls_prob.shape == (1, 20, 3)
+    np.testing.assert_allclose(cls_prob.sum(-1), 1.0, rtol=1e-4)
+    assert np.isfinite(bbox).all()
+
+
+@pytest.mark.slow
+def test_rcnn_train_net_step():
+    """End-to-end fwd+bwd through RPN losses + proposal_target (host
+    CustomOp) + Fast R-CNN losses."""
+    np.random.seed(1)
+    net = rcnn.get_symbol_train(num_classes=3, batch_rois=8,
+                                post_nms=16, pre_nms=100)
+    h = w = 64
+    fh = fw = h // 16
+    na = rcnn.NUM_ANCHORS
+    shapes = dict(data=(1, 3, h, w), im_info=(1, 3),
+                  gt_boxes=(1, 2, 5), label=(1, na * fh * fw),
+                  bbox_target=(1, 4 * na, fh, fw),
+                  bbox_weight=(1, 4 * na, fh, fw))
+    ex = net.simple_bind(grad_req="write", **shapes)
+    rng = np.random.RandomState(2)
+    for n in ex.arg_dict:
+        if n not in shapes:
+            ex.arg_dict[n][:] = mx.nd.array(
+                rng.uniform(-0.01, 0.01,
+                            ex.arg_dict[n].shape).astype(np.float32))
+    ex.arg_dict["data"][:] = mx.nd.array(
+        rng.rand(1, 3, h, w).astype(np.float32))
+    ex.arg_dict["im_info"][:] = mx.nd.array(
+        np.array([[h, w, 1.0]], np.float32))
+    ex.arg_dict["gt_boxes"][:] = mx.nd.array(
+        np.array([[[4, 4, 30, 30, 1], [34, 34, 60, 60, 2]]], np.float32))
+    lab = rng.randint(-1, 2, (1, na * fh * fw)).astype(np.float32)
+    ex.arg_dict["label"][:] = mx.nd.array(lab)
+    ex.arg_dict["bbox_target"][:] = mx.nd.array(
+        rng.randn(1, 4 * na, fh, fw).astype(np.float32) * 0.1)
+    ex.arg_dict["bbox_weight"][:] = mx.nd.array(
+        (rng.rand(1, 4 * na, fh, fw) > 0.7).astype(np.float32))
+    ex.forward(is_train=True)  # deferred: backward runs fused fwd+bwd
+    ex.backward()
+    assert all(np.isfinite(o.asnumpy()).all() for o in ex.outputs)
+    g = ex.grad_dict["rpn_conv_3x3_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    g2 = ex.grad_dict["conv1_1_weight"].asnumpy()
+    assert np.isfinite(g2).all()

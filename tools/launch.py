@@ -245,6 +245,11 @@ def submit_sge(args):
                                                   text=True)
                     m = re.search(r"job(?:-array)? (\d+)", out)
                     server_job = m.group(1) if m else None
+                    if server_job is None:
+                        print("WARNING: could not parse qsub job id "
+                              "from %r — the server job array will NOT "
+                              "be qdel'd automatically" % out.strip(),
+                              file=sys.stderr)
         return 0
     finally:
         if server_job:
