@@ -612,15 +612,22 @@ class ImageRecordIter(DataIter):
         self._worker = threading.Thread(target=worker, daemon=True)
         self._worker.start()
 
-    def _drain_worker(self):
+    def _drain_worker(self, deadline: Optional[float] = None):
         """Stop + drain until the prefetch worker exits (it could be
-        blocked on a full queue); shared by reset() and close()."""
+        blocked on a full queue); shared by reset() and close().
+        ``deadline`` (seconds) bounds the wait — interpreter shutdown
+        can kill the daemon thread in a state where is_alive() never
+        flips, and an unbounded drain would hang process exit."""
         import queue
+        import time as _time
 
         self._stop = True
         if self._worker is None:
             return
+        t0 = _time.monotonic()
         while self._worker.is_alive():
+            if deadline is not None and _time.monotonic() - t0 > deadline:
+                return
             try:
                 self._queue.get(timeout=0.1)
             except queue.Empty:
@@ -650,18 +657,18 @@ class ImageRecordIter(DataIter):
 
     __next__ = next
 
-    def close(self):
+    def close(self, timeout: Optional[float] = None):
         """Stop the prefetch worker and tear down the decode pool
         deterministically (a GC'd ThreadPool raises noisy errors at
         interpreter shutdown)."""
-        self._drain_worker()
+        self._drain_worker(deadline=timeout)
         if self._pool is not None:
             self._pool.terminate()
             self._pool = None
 
     def __del__(self):
         try:
-            self.close()
+            self.close(timeout=2.0)  # bounded: never hang process exit
         except Exception:
             pass
 

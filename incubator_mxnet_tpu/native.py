@@ -106,7 +106,8 @@ def assemble_batch(images, out: np.ndarray, mean=None, std=None) -> bool:
     if cdll is None or not images:
         return False
     h, w, c = images[0].shape
-    if out.shape[1:] != (c, h, w) or out.shape[0] < len(images):
+    if out.shape[1:] != (c, h, w) or out.shape[0] < len(images) \
+            or not out.flags.c_contiguous:
         return False
     for im in images:
         if im.shape != (h, w, c) or im.dtype != np.uint8 \

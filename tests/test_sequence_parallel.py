@@ -97,3 +97,21 @@ def test_mixed_dp_sp_mesh():
     out = jax.jit(fn)(q, k, v)
     np.testing.assert_allclose(np.asarray(out), _oracle(q, k, v, True),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.skipif(jax.default_backend() == "cpu",
+                    reason="pallas TPU kernel needs a TPU backend")
+def test_flash_attention_matches_oracle():
+    """impl='flash' (Pallas kernel) matches the materialized oracle."""
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(2, 4, 256, 128).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    k = jnp.asarray(rng.randn(2, 4, 256, 128).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    v = jnp.asarray(rng.randn(2, 4, 256, 128).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    out = attention(q, k, v, causal=True, impl="flash")
+    ref = attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                    v.astype(jnp.float32), causal=True, impl="xla")
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.05)
