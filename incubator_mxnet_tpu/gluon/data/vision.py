@@ -11,9 +11,10 @@ import struct
 import numpy as np
 
 from ...ndarray import array as nd_array
-from .dataset import Dataset
+from .dataset import Dataset, RecordFileDataset
 
-__all__ = ["MNIST", "FashionMNIST", "CIFAR10"]
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10",
+           "ImageRecordDataset", "ImageFolderDataset"]
 
 
 class _DownloadedDataset(Dataset):
@@ -106,3 +107,60 @@ class CIFAR10(_DownloadedDataset):
             data = data.astype(np.uint8)
         self._data = nd_array(data, dtype=np.uint8)
         self._label = label
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Dataset over a RecordIO file of packed images (reference
+    ``gluon/data/vision.py:166``): each item decodes to
+    (image NDArray HWC, label)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ... import image as image_mod
+        from ...recordio import unpack
+
+        record = super().__getitem__(idx)
+        header, img = unpack(record)
+        data = image_mod.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+
+class ImageFolderDataset(Dataset):
+    """Dataset over a class-per-subdirectory image tree (reference
+    ``gluon/data/vision.py:197``); ``synsets[i]`` names label ``i``."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = (".jpg", ".jpeg", ".png")
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if fname.lower().endswith(self._exts):
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __getitem__(self, idx):
+        from ... import image as image_mod
+
+        fname, label = self.items[idx]
+        data = image_mod.imread(fname, self._flag)
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+    def __len__(self):
+        return len(self.items)

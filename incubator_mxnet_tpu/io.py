@@ -662,6 +662,11 @@ class ImageRecordIter(DataIter):
         deterministically (a GC'd ThreadPool raises noisy errors at
         interpreter shutdown)."""
         self._drain_worker(deadline=timeout)
+        if self._worker is not None and self._worker.is_alive():
+            # timed-out drain: the worker may still be inside
+            # pool.map — terminating the pool under it would raise in
+            # the worker and leave it blocked on queue.put forever
+            return
         if self._pool is not None:
             self._pool.terminate()
             self._pool = None

@@ -54,8 +54,13 @@ def attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
 
     d = q.shape[-1]
     scale = (1.0 / d ** 0.5) if scale is None else scale
+    # offsets may be TRACED values (lax.axis_index arithmetic under
+    # shard_map) — only concrete python zeros qualify for the flash path
+    def _zero(off):
+        return isinstance(off, int) and off == 0
+
     use_flash = impl == "flash"
-    if use_flash and (q_offset != 0 or k_offset != 0):
+    if use_flash and not (_zero(q_offset) and _zero(k_offset)):
         raise ValueError("impl='flash' does not support q_offset/"
                          "k_offset (the kernel masks from local "
                          "position 0); use impl='xla' for shard-offset "
@@ -63,7 +68,8 @@ def attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
     if impl == "auto":
         # 'axon' is this session's TPU-via-tunnel platform name
         use_flash = (jax.default_backend() in ("tpu", "axon")
-                     and q.ndim == 4 and q_offset == 0 and k_offset == 0
+                     and q.ndim == 4 and _zero(q_offset)
+                     and _zero(k_offset)
                      and d % 128 == 0 and q.shape[-2] % 128 == 0
                      and k.shape[-2] % 128 == 0)
     if use_flash:

@@ -100,12 +100,27 @@ class IndexedRecordIO(MXRecordIO):
 
     def __init__(self, idx_path: str, uri: str, flag: str,
                  key_type=int):
+        import threading
+
         self.idx_path = idx_path
         self.idx = {}
         self.keys = []
         self.key_type = key_type
         self.fidx = None
+        self._rlock = threading.Lock()
         super().__init__(uri, flag)
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d.pop("_rlock", None)  # locks don't pickle
+        d["fidx"] = None
+        return d
+
+    def __setstate__(self, d):
+        import threading
+
+        self._rlock = threading.Lock()
+        super().__setstate__(d)
 
     def open(self):
         super().open()
@@ -146,8 +161,12 @@ class IndexedRecordIO(MXRecordIO):
         self.fp.seek(self.idx[idx])
 
     def read_idx(self, idx):
-        self.seek(idx)
-        return self.read()
+        # atomic seek+read: threaded consumers (gluon DataLoader prefetch
+        # workers) share this handle, and an interleaved seek would make
+        # read() consume bytes at the wrong offset
+        with self._rlock:
+            self.seek(idx)
+            return self.read()
 
     def write_idx(self, idx, buf: bytes):
         key = self.key_type(idx)

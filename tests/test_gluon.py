@@ -268,3 +268,53 @@ def test_model_zoo_inception_v3():
     net.initialize(mx.init.Xavier())
     out = net(mx.nd.ones((1, 3, 299, 299)))
     assert out.shape == (1, 10)
+
+
+def test_image_record_and_folder_datasets(tmp_path):
+    """ImageRecordDataset / ImageFolderDataset (reference
+    gluon/data/vision.py:166,197) decode to (HWC image, label)."""
+    cv2 = pytest.importorskip("cv2")
+    import importlib.util
+    import os
+
+    _spec = importlib.util.spec_from_file_location(
+        "tp_im2rec", os.path.join(os.path.dirname(__file__), "..",
+                                  "tools", "im2rec.py"))
+    im2rec = importlib.util.module_from_spec(_spec)
+    _spec.loader.exec_module(im2rec)
+
+    rng = np.random.RandomState(0)
+    root = tmp_path / "imgs"
+    for cls in ("ant", "bee"):
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            cv2.imwrite(str(d / ("%d.jpg" % i)),
+                        rng.randint(0, 255, (20, 24, 3)).astype(np.uint8))
+
+    folder = mx.gluon.data.vision.ImageFolderDataset(str(root))
+    assert folder.synsets == ["ant", "bee"]
+    assert len(folder) == 6
+    img, label = folder[4]
+    assert img.shape == (20, 24, 3) and label == 1
+
+    prefix = str(tmp_path / "pack")
+    im2rec.main([prefix, str(root)])
+    rec = mx.gluon.data.vision.ImageRecordDataset(prefix + ".rec")
+    assert len(rec) == 6
+    img, label = rec[0]
+    assert img.shape == (20, 24, 3) and float(label) in (0.0, 1.0)
+    # transform hook
+    rec_t = mx.gluon.data.vision.ImageRecordDataset(
+        prefix + ".rec",
+        transform=lambda d, l: (d.astype("float32") / 255.0, l))
+    img_t, _ = rec_t[0]
+    assert img_t.dtype == np.float32 and float(img_t.asnumpy().max()) <= 1
+    # feeds a DataLoader end-to-end — including THREADED workers, which
+    # share the record handle (read_idx is lock-atomic)
+    for workers in (0, 2):
+        loader = mx.gluon.data.DataLoader(rec_t, batch_size=3,
+                                          num_workers=workers)
+        batches = list(loader)
+        assert len(batches) == 2
+        assert batches[0][0].shape == (3, 20, 24, 3)

@@ -202,3 +202,23 @@ def test_yarn_command():
     assert "DMLC_PS_ROOT_URI" in joined
     assert "DMLC_ROLE" not in joined
     assert argv[-2:] == ["python", "train.py"]
+
+
+def test_kill_job_commands(tmp_path, capsys):
+    """kill_job (reference tools/kill-mxnet.py) builds per-host pkill
+    lines; --dry-run prints without executing."""
+    _ks = importlib.util.spec_from_file_location(
+        "tp_kill_job", os.path.join(REPO, "tools", "kill_job.py"))
+    kill_job = importlib.util.module_from_spec(_ks)
+    _ks.loader.exec_module(kill_job)
+
+    assert kill_job.build_kill_command("train.py") == \
+        ["pkill", "-9", "-f", "train.py"]
+    assert kill_job.build_kill_command("train.py", "alice") == \
+        ["pkill", "-u", "alice", "-9", "-f", "train.py"]
+    hf = tmp_path / "hosts"
+    hf.write_text("h1\nh2:4\n")
+    rc = kill_job.main(["-H", str(hf), "--dry-run", "train.py"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "ssh" in out and "h1" in out and "h2" in out

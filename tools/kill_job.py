@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Kill a distributed job's processes on every host
+(reference ``tools/kill-mxnet.py``, modernized: pkill by pattern, local
+mode when no hostfile, dry-run prints the commands).
+
+Usage:
+    python tools/kill_job.py [-H hostfile] [-u user] [--dry-run] pattern
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from launch import read_hostfile  # noqa: E402
+
+
+def build_kill_command(pattern: str, user: str = None):
+    """The per-host kill line (pure — unit-testable)."""
+    cmd = ["pkill", "-9", "-f", pattern]
+    if user:
+        cmd[1:1] = ["-u", user]
+    return cmd
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("-u", "--user", default=None)
+    ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument("pattern", help="process command-line pattern")
+    args = ap.parse_args(argv)
+
+    import shlex
+
+    kill = build_kill_command(args.pattern, args.user)
+    if args.hostfile:
+        hosts = [h for h, _ in read_hostfile(args.hostfile)]
+        # quoted: the remote shell must see the pattern as ONE pkill
+        # argument, not word-split it into extra arguments
+        remote = " ".join(shlex.quote(c) for c in kill)
+        cmds = [["ssh", "-o", "StrictHostKeyChecking=no", h, remote]
+                for h in hosts]
+    else:
+        cmds = [kill]
+    rc = 0
+    for cmd in cmds:
+        print(" ".join(cmd))
+        if not args.dry_run:
+            # pkill exits 1 when nothing matched — not an error here
+            r = subprocess.call(cmd)
+            rc = rc if r in (0, 1) else r
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
