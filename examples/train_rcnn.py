@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Train Faster R-CNN end-to-end on synthetic detection data
+(reference ``example/rcnn/train_end2end.py``)::
+
+    python examples/train_rcnn.py --num-epochs 1 --num-images 8
+
+The driver feeds the four-input train net (data, im_info, gt_boxes, RPN
+label/bbox targets) with a minimal anchor-target assigner — enough to
+drive every loss head; real datasets plug in through the same arrays.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import fit  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu.models import rcnn  # noqa: E402
+
+
+def synthetic_batch(rng, size, num_classes, na, fs):
+    """One image + gt boxes + dense RPN targets (uniform sampling —
+    the reference's AnchorLoader role at smoke scale)."""
+    fh = fw = size // fs
+    data = rng.rand(1, 3, size, size).astype(np.float32)
+    im_info = np.array([[size, size, 1.0]], np.float32)
+    n_gt = rng.randint(1, 3)
+    boxes = []
+    for _ in range(n_gt):
+        x1, y1 = rng.randint(0, size // 2, 2)
+        w, h = rng.randint(size // 4, size // 2, 2)
+        boxes.append([x1, y1, min(x1 + w, size - 1),
+                      min(y1 + h, size - 1),
+                      rng.randint(1, num_classes)])
+    gt = np.full((1, 4, 5), -1, np.float32)
+    gt[0, :n_gt] = boxes
+    label = rng.choice([-1.0, 0.0, 1.0], (1, na * fh * fw),
+                       p=[0.7, 0.2, 0.1]).astype(np.float32)
+    bbox_t = rng.randn(1, 4 * na, fh, fw).astype(np.float32) * 0.1
+    bbox_w = (rng.rand(1, 4 * na, fh, fw) > 0.9).astype(np.float32)
+    return data, im_info, gt, label, bbox_t, bbox_w
+
+
+def main():
+    ap = argparse.ArgumentParser(description="Train Faster R-CNN")
+    ap.add_argument("--num-classes", type=int, default=4)
+    ap.add_argument("--image-size", type=int, default=128)
+    ap.add_argument("--num-images", type=int, default=8)
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.001)
+    ap.add_argument("--batch-rois", type=int, default=32)
+    ap.add_argument("--post-nms", type=int, default=32)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    na, fs = rcnn.NUM_ANCHORS, 16
+    size = args.image_size
+    fh = fw = size // fs
+    net = rcnn.get_symbol_train(num_classes=args.num_classes,
+                                batch_rois=args.batch_rois,
+                                post_nms=args.post_nms, pre_nms=256)
+    shapes = dict(data=(1, 3, size, size), im_info=(1, 3),
+                  gt_boxes=(1, 4, 5), label=(1, na * fh * fw),
+                  bbox_target=(1, 4 * na, fh, fw),
+                  bbox_weight=(1, 4 * na, fh, fw))
+    ex = net.simple_bind(grad_req="write", **shapes)
+
+    rng = np.random.RandomState(0)
+    init = mx.initializer.Xavier()
+    for n in ex.arg_dict:
+        if n not in shapes:
+            init(mx.init.InitDesc(n), ex.arg_dict[n])
+    opt = mx.optimizer.create("sgd", learning_rate=args.lr, momentum=0.9,
+                              wd=5e-4)
+    updater = mx.optimizer.get_updater(opt)
+
+    for epoch in range(args.num_epochs):
+        total = 0.0
+        for it in range(args.num_images):
+            batch = synthetic_batch(rng, size, args.num_classes, na, fs)
+            for name, val in zip(["data", "im_info", "gt_boxes", "label",
+                                  "bbox_target", "bbox_weight"], batch):
+                ex.arg_dict[name][:] = mx.nd.array(val)
+            ex.forward(is_train=True)
+            ex.backward()
+            for i, name in enumerate(net.list_arguments()):
+                if name in shapes:
+                    continue
+                g = ex.grad_dict.get(name)
+                if g is not None:
+                    updater(i, g, ex.arg_dict[name])
+            outs = [o.asnumpy() for o in ex.outputs]
+            # rpn_cls_prob, rpn_bbox_loss, cls_prob, bbox_loss
+            total += float(outs[1].sum() + outs[3].sum())
+        logging.info("Epoch[%d] rcnn bbox-loss sum=%.4f", epoch, total)
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,9 +55,16 @@ def attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
     d = q.shape[-1]
     scale = (1.0 / d ** 0.5) if scale is None else scale
     # offsets may be TRACED values (lax.axis_index arithmetic under
-    # shard_map) — only concrete python zeros qualify for the flash path
+    # shard_map) — only CONCRETE zeros qualify for the flash path
     def _zero(off):
-        return isinstance(off, int) and off == 0
+        import numpy as np
+
+        if isinstance(off, (int, np.integer)):
+            return int(off) == 0
+        try:
+            return bool(off == 0)  # concrete array scalars
+        except Exception:  # traced value: not concretizable
+            return False
 
     use_flash = impl == "flash"
     if use_flash and not (_zero(q_offset) and _zero(k_offset)):

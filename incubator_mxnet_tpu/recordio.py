@@ -60,6 +60,11 @@ class MXRecordIO:
 
     def __setstate__(self, d):
         self.__dict__.update(d)
+        if self.flag == "w":
+            # reopening a writer would TRUNCATE the file already written
+            raise MXNetError(
+                "cannot unpickle a writable record file (reopening "
+                "would truncate %s)" % self.uri)
         self.open()
 
     def reset(self):

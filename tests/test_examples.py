@@ -80,3 +80,10 @@ def test_train_ssd_small():
                "--num-epochs", "1", "--num-examples", "8",
                "--batch-size", "4")
     assert "multibox_loss" in out
+
+
+def test_train_rcnn_small():
+    out = _run("train_rcnn.py", "--num-epochs", "1", "--num-images", "2",
+               "--image-size", "64", "--batch-rois", "8",
+               "--post-nms", "8")
+    assert "done" in out and "bbox-loss" in out

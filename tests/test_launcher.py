@@ -216,9 +216,17 @@ def test_kill_job_commands(tmp_path, capsys):
         ["pkill", "-9", "-f", "train.py"]
     assert kill_job.build_kill_command("train.py", "alice") == \
         ["pkill", "-u", "alice", "-9", "-f", "train.py"]
+    assert kill_job._self_proof("train.py") == "[t]rain.py"
     hf = tmp_path / "hosts"
     hf.write_text("h1\nh2:4\n")
     rc = kill_job.main(["-H", str(hf), "--dry-run", "train.py"])
     out = capsys.readouterr().out
     assert rc == 0
     assert "ssh" in out and "h1" in out and "h2" in out
+    # remote pattern is self-proofed so the ssh/pkill line can't match
+    # its own command line
+    assert "[t]rain.py" in out
+    # local mode: pgrep-based, excludes self/parent
+    rc = kill_job.main(["--dry-run", "train.py"])
+    out = capsys.readouterr().out
+    assert rc == 0 and out.startswith("pgrep")

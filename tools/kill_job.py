@@ -35,25 +35,55 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     import shlex
+    import signal
 
-    kill = build_kill_command(args.pattern, args.user)
     if args.hostfile:
+        # bracket the first matchable char so the remote shell/pkill
+        # command line (which contains the pattern literally) cannot
+        # match itself — the modern form of ps|grep -v grep
+        kill = build_kill_command(_self_proof(args.pattern), args.user)
         hosts = [h for h, _ in read_hostfile(args.hostfile)]
-        # quoted: the remote shell must see the pattern as ONE pkill
-        # argument, not word-split it into extra arguments
         remote = " ".join(shlex.quote(c) for c in kill)
         cmds = [["ssh", "-o", "StrictHostKeyChecking=no", h, remote]
                 for h in hosts]
-    else:
-        cmds = [kill]
-    rc = 0
-    for cmd in cmds:
-        print(" ".join(cmd))
-        if not args.dry_run:
-            # pkill exits 1 when nothing matched — not an error here
-            r = subprocess.call(cmd)
-            rc = rc if r in (0, 1) else r
-    return rc
+        rc = 0
+        for cmd in cmds:
+            print(" ".join(cmd))
+            if not args.dry_run:
+                # pkill exits 1 when nothing matched — not an error here
+                r = subprocess.call(cmd)
+                rc = rc if r in (0, 1) else r
+        return rc
+
+    # local mode: pgrep + explicit kills, excluding THIS process and its
+    # parent (our own argv contains the pattern)
+    pgrep = ["pgrep", "-f", args.pattern]
+    if args.user:
+        pgrep[1:1] = ["-u", args.user]
+    print(" ".join(pgrep))
+    if args.dry_run:
+        return 0
+    out = subprocess.run(pgrep, capture_output=True, text=True)
+    skip = {os.getpid(), os.getppid()}
+    for tok in out.stdout.split():
+        pid = int(tok)
+        if pid in skip:
+            continue
+        try:
+            os.kill(pid, signal.SIGKILL)
+            print("killed %d" % pid)
+        except ProcessLookupError:
+            pass
+    return 0
+
+
+def _self_proof(pattern: str) -> str:
+    """``train.py`` → ``[t]rain.py``: matches the same targets but not a
+    command line containing the bracketed literal."""
+    for i, ch in enumerate(pattern):
+        if ch.isalnum():
+            return pattern[:i] + "[" + ch + "]" + pattern[i + 1:]
+    return pattern
 
 
 if __name__ == "__main__":
