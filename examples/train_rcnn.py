@@ -16,7 +16,9 @@ import sys
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from common import fit  # noqa: E402
+# importing the package applies the TP_EXAMPLES_FORCE_CPU device pin
+# (common/__init__.py) before the framework initializes a backend
+import common  # noqa: E402,F401
 
 import incubator_mxnet_tpu as mx  # noqa: E402
 from incubator_mxnet_tpu.models import rcnn  # noqa: E402

@@ -24,10 +24,11 @@ from .sequence import (attention, ring_attention, ulysses_attention,
                        sequence_parallel_attention)
 from .pipeline import pipeline_apply, pipeline_parallel_apply
 from .moe import moe_ffn, expert_parallel_moe
+from .checkpoint import save_sharded, restore_sharded
 
 __all__ = ["build_mesh", "default_mesh", "data_parallel_spec",
            "all_reduce", "all_gather", "reduce_scatter", "ring_permute",
            "barrier_sync", "FusedTrainStep", "attention", "ring_attention",
            "ulysses_attention", "sequence_parallel_attention",
            "pipeline_apply", "pipeline_parallel_apply", "moe_ffn",
-           "expert_parallel_moe"]
+           "expert_parallel_moe", "save_sharded", "restore_sharded"]

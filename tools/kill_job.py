@@ -64,7 +64,11 @@ def main(argv=None):
     if args.dry_run:
         return 0
     out = subprocess.run(pgrep, capture_output=True, text=True)
+    if out.returncode not in (0, 1):  # 1 = no match; >1 = real error
+        sys.stderr.write(out.stderr)
+        return out.returncode
     skip = {os.getpid(), os.getppid()}
+    rc = 0
     for tok in out.stdout.split():
         pid = int(tok)
         if pid in skip:
@@ -74,12 +78,19 @@ def main(argv=None):
             print("killed %d" % pid)
         except ProcessLookupError:
             pass
-    return 0
+        except PermissionError:
+            print("no permission to kill %d" % pid, file=sys.stderr)
+            rc = 1
+    return rc
 
 
 def _self_proof(pattern: str) -> str:
     """``train.py`` → ``[t]rain.py``: matches the same targets but not a
-    command line containing the bracketed literal."""
+    command line containing the bracketed literal.  Patterns that already
+    use regex syntax are left untouched — bracketing a char inside a
+    class or escape would corrupt them."""
+    if any(ch in pattern for ch in "[]\\^$|?*+(){}"):
+        return pattern
     for i, ch in enumerate(pattern):
         if ch.isalnum():
             return pattern[:i] + "[" + ch + "]" + pattern[i + 1:]
