@@ -30,7 +30,9 @@ def _maybe_init_distributed():
         return
     if int(os.environ.get("DMLC_NUM_SERVER", "0")) > 0:
         return  # PS transport owns rendezvous; jax stays single-process
-    coord = os.environ.get("KVSTORE_COORDINATOR")
+    coord = os.environ.get("KVSTORE_COORDINATOR") \
+        or os.environ.get("TP_KVSTORE_COORDINATOR") \
+        or os.environ.get("MXNET_KVSTORE_COORDINATOR")
     n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
     if not coord or n <= 1:
         return
@@ -73,7 +75,7 @@ _OPTIONAL = [
     ("registry", ()), ("profiler", ()), ("visualization", ("viz",)),
     ("test_utils", ()), ("parallel", ()), ("models", ()), ("gluon", ()),
     ("rnn", ()), ("image", ()), ("operator", ()), ("rtc", ()),
-    ("contrib", ()),
+    ("contrib", ()), ("log", ()), ("libinfo", ()),
 ]
 
 import importlib as _importlib
@@ -101,6 +103,9 @@ if "attribute" in globals():
     AttrScope = attribute.AttrScope  # noqa: F821
 if "optimizer" in globals():
     Optimizer = optimizer.Optimizer  # noqa: F821
+
+if "libinfo" in globals():
+    __version__ = libinfo.__version__  # noqa: F821
 
 waitall = nd.waitall
 

@@ -1,0 +1,25 @@
+"""Library discovery + version (reference ``python/mxnet/libinfo.py``).
+
+The reference located ``libmxnet.so``; here the native component is the
+on-demand-built ``_native.so`` (recordio scanner / batch assembler) and
+the compute library is jax itself.
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+__version__ = "0.11.0.tp3"  # tracks the reference API version + round
+
+
+def find_lib_path() -> List[str]:
+    """Paths of the native libraries this build uses (may be empty when
+    the C++ toolchain is unavailable — every native piece has a python
+    fallback)."""
+    from . import native
+
+    paths = []
+    if native.lib() is not None:
+        paths.append(os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "_native.so"))
+    return paths

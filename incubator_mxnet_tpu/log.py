@@ -1,0 +1,66 @@
+"""Logging utilities (reference ``python/mxnet/log.py``): a colored
+single-letter-level formatter and ``get_logger``."""
+from __future__ import annotations
+
+import logging
+import sys
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+NOTSET = logging.NOTSET
+
+__all__ = ["get_logger", "CRITICAL", "ERROR", "WARNING", "INFO", "DEBUG",
+           "NOTSET"]
+
+_LABELS = {logging.CRITICAL: "C", logging.ERROR: "E",
+           logging.WARNING: "W", logging.INFO: "I", logging.DEBUG: "D"}
+
+
+class _Formatter(logging.Formatter):
+    """``L MMDD HH:MM:SS pid file:line] msg`` with ANSI level colors on
+    ttys (the reference glog-style line)."""
+
+    def __init__(self, colored: bool):
+        super().__init__(datefmt="%m%d %H:%M:%S")
+        self._colored = colored
+
+    def _color(self, level):
+        if level >= logging.WARNING:
+            return "\x1b[31m"
+        if level >= logging.INFO:
+            return "\x1b[32m"
+        return "\x1b[34m"
+
+    def format(self, record):
+        label = _LABELS.get(record.levelno, "U")
+        head = "%s%s %s %s:%d]" % (
+            label, "", self.formatTime(record, self.datefmt),
+            record.filename, record.lineno)
+        if self._colored:
+            head = self._color(record.levelno) + head + "\x1b[0m"
+        return "%s %s" % (head, record.getMessage())
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Logger with the framework formatter attached once
+    (reference ``log.py:getLogger``)."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_tp_log_init", False):
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+        colored = False
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+        colored = getattr(sys.stderr, "isatty", lambda: False)()
+    handler.setFormatter(_Formatter(colored))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._tp_log_init = True
+    return logger
+
+
+getLogger = get_logger  # reference spelling

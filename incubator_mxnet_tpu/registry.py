@@ -1,0 +1,71 @@
+"""Generic class registry factories (reference
+``python/mxnet/registry.py``): build ``register``/``alias``/``create``
+functions for any base class — the machinery behind
+``mx.optimizer.register``-style APIs."""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Type
+
+from .base import MXNetError
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+_REGISTRY: Dict[type, Dict[str, type]] = {}
+
+
+def get_register_func(base_class: type, nickname: str):
+    """-> ``register(klass, name=None)`` storing subclasses by
+    lower-cased name (reference ``registry.py:32``)."""
+    registry = _REGISTRY.setdefault(base_class, {})
+
+    def register(klass: type, name: str = None):
+        if not issubclass(klass, base_class):
+            raise MXNetError("can only register subclass of %s"
+                             % base_class.__name__)
+        key = (name or klass.__name__).lower()
+        registry[key] = klass
+        return klass
+
+    register.__doc__ = "Register a %s to the registry" % nickname
+    return register
+
+
+def get_alias_func(base_class: type, nickname: str):
+    """-> ``alias(*names)`` decorator (reference ``registry.py:70``)."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+
+        return reg
+
+    return alias
+
+
+def get_create_func(base_class: type, nickname: str):
+    """-> ``create(name_or_instance, *args, **kwargs)`` (reference
+    ``registry.py:97``); also accepts the JSON ``[name, kwargs]`` form
+    produced by e.g. ``Augmenter.dumps``."""
+    registry = _REGISTRY.setdefault(base_class, {})
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            return args[0]
+        if not args or not isinstance(args[0], str):
+            raise MXNetError("%s name required as the first argument"
+                             % nickname)
+        name, args = args[0], args[1:]
+        if name.startswith("[") and not args and not kwargs:
+            name, kwargs = json.loads(name)
+        key = name.lower()
+        if key not in registry:
+            raise MXNetError("%s %s is not registered (known: %s)"
+                             % (nickname, name, sorted(registry)))
+        return registry[key](*args, **kwargs)
+
+    create.__doc__ = "Create a %s instance by name" % nickname
+    return create

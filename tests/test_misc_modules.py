@@ -1,0 +1,63 @@
+"""log / registry / libinfo parity modules (reference
+``python/mxnet/{log,registry,libinfo}.py``)."""
+import logging
+
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.base import MXNetError
+
+
+def test_get_logger(tmp_path, capsys):
+    logger = mx.log.get_logger("tp_test_logger", level=mx.log.INFO)
+    logger.info("hello %d", 7)
+    # idempotent: second call returns the same configured logger with
+    # ONE handler
+    again = mx.log.get_logger("tp_test_logger")
+    assert again is logger and len(logger.handlers) == 1
+    path = tmp_path / "x.log"
+    flog = mx.log.get_logger("tp_file_logger", filename=str(path),
+                             level=logging.DEBUG)
+    flog.warning("to file")
+    flog.handlers[0].flush()
+    text = path.read_text()
+    assert "to file" in text and text.startswith("W ")
+
+
+def test_registry_factories():
+    class Thing:
+        def __init__(self, power=1):
+            self.power = power
+
+    register = mx.registry.get_register_func(Thing, "thing")
+    alias = mx.registry.get_alias_func(Thing, "thing")
+    create = mx.registry.get_create_func(Thing, "thing")
+
+    @alias("mega", "Giga")
+    class MegaThing(Thing):
+        pass
+
+    register(MegaThing)
+    t = create("mega", power=3)
+    assert isinstance(t, MegaThing) and t.power == 3
+    assert isinstance(create("giga"), MegaThing)  # case-insensitive
+    assert isinstance(create("megathing"), MegaThing)
+    assert create(t) is t  # instance passthrough
+    # JSON form (Augmenter.dumps convention)
+    t2 = create('["mega", {"power": 5}]')
+    assert t2.power == 5
+    with pytest.raises(MXNetError):
+        create("nosuch")
+    with pytest.raises(MXNetError):
+        register(int)  # not a subclass
+
+
+def test_libinfo():
+    assert mx.__version__.startswith("0.11")
+    paths = mx.libinfo.find_lib_path()
+    # native lib present iff the toolchain built it; either way the call
+    # succeeds and returns existing paths
+    import os
+
+    for p in paths:
+        assert os.path.exists(p)
