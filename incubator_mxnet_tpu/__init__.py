@@ -16,7 +16,7 @@ Usage mirrors the reference::
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
+from .libinfo import __version__  # single source of the version
 
 # Collective-worker rendezvous must run BEFORE anything touches the XLA
 # backend (jax.distributed.initialize contract).  A process spawned by
@@ -30,9 +30,11 @@ def _maybe_init_distributed():
         return
     if int(os.environ.get("DMLC_NUM_SERVER", "0")) > 0:
         return  # PS transport owns rendezvous; jax stays single-process
+    from .base import get_env
+
+    # bare name (tools/launch.py contract) or the TP_/MXNET_ prefixes
     coord = os.environ.get("KVSTORE_COORDINATOR") \
-        or os.environ.get("TP_KVSTORE_COORDINATOR") \
-        or os.environ.get("MXNET_KVSTORE_COORDINATOR")
+        or get_env("KVSTORE_COORDINATOR")
     n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
     if not coord or n <= 1:
         return
@@ -75,7 +77,7 @@ _OPTIONAL = [
     ("registry", ()), ("profiler", ()), ("visualization", ("viz",)),
     ("test_utils", ()), ("parallel", ()), ("models", ()), ("gluon", ()),
     ("rnn", ()), ("image", ()), ("operator", ()), ("rtc", ()),
-    ("contrib", ()), ("log", ()), ("libinfo", ()),
+    ("contrib", ()), ("log", ()), ("libinfo", ()), ("torch", ()),
 ]
 
 import importlib as _importlib
@@ -103,9 +105,6 @@ if "attribute" in globals():
     AttrScope = attribute.AttrScope  # noqa: F821
 if "optimizer" in globals():
     Optimizer = optimizer.Optimizer  # noqa: F821
-
-if "libinfo" in globals():
-    __version__ = libinfo.__version__  # noqa: F821
 
 waitall = nd.waitall
 

@@ -59,6 +59,9 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
     handler.setFormatter(_Formatter(colored))
     logger.addHandler(handler)
     logger.setLevel(level)
+    # this logger has its own formatter; propagating to a configured
+    # root handler would print every record twice
+    logger.propagate = False
     logger._tp_log_init = True
     return logger
 

@@ -1,30 +1,38 @@
 """Generic class registry factories (reference
 ``python/mxnet/registry.py``): build ``register``/``alias``/``create``
 functions for any base class — the machinery behind
-``mx.optimizer.register``-style APIs."""
+``mx.optimizer.register``-style APIs.  Storage delegates to
+:class:`base.Registry` (one registry mechanism in the codebase: locked,
+override-warning)."""
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, Type
+from typing import Dict
 
-from .base import MXNetError
+from .base import MXNetError, Registry
 
 __all__ = ["get_register_func", "get_alias_func", "get_create_func"]
 
-_REGISTRY: Dict[type, Dict[str, type]] = {}
+_REGISTRY: Dict[type, Registry] = {}
+
+
+def _registry_of(base_class: type, nickname: str) -> Registry:
+    reg = _REGISTRY.get(base_class)
+    if reg is None:
+        reg = _REGISTRY[base_class] = Registry(nickname)
+    return reg
 
 
 def get_register_func(base_class: type, nickname: str):
     """-> ``register(klass, name=None)`` storing subclasses by
     lower-cased name (reference ``registry.py:32``)."""
-    registry = _REGISTRY.setdefault(base_class, {})
+    registry = _registry_of(base_class, nickname)
 
     def register(klass: type, name: str = None):
         if not issubclass(klass, base_class):
             raise MXNetError("can only register subclass of %s"
                              % base_class.__name__)
-        key = (name or klass.__name__).lower()
-        registry[key] = klass
+        registry.register(klass, name=name or klass.__name__)
         return klass
 
     register.__doc__ = "Register a %s to the registry" % nickname
@@ -50,7 +58,7 @@ def get_create_func(base_class: type, nickname: str):
     """-> ``create(name_or_instance, *args, **kwargs)`` (reference
     ``registry.py:97``); also accepts the JSON ``[name, kwargs]`` form
     produced by e.g. ``Augmenter.dumps``."""
-    registry = _REGISTRY.setdefault(base_class, {})
+    registry = _registry_of(base_class, nickname)
 
     def create(*args, **kwargs):
         if args and isinstance(args[0], base_class):
@@ -60,12 +68,12 @@ def get_create_func(base_class: type, nickname: str):
                              % nickname)
         name, args = args[0], args[1:]
         if name.startswith("[") and not args and not kwargs:
-            name, kwargs = json.loads(name)
-        key = name.lower()
-        if key not in registry:
-            raise MXNetError("%s %s is not registered (known: %s)"
-                             % (nickname, name, sorted(registry)))
-        return registry[key](*args, **kwargs)
+            try:
+                name, kwargs = json.loads(name)
+            except (json.JSONDecodeError, ValueError, TypeError) as exc:
+                raise MXNetError("invalid JSON %s spec %r: %s"
+                                 % (nickname, name, exc)) from exc
+        return registry.get(name)(*args, **kwargs)
 
     create.__doc__ = "Create a %s instance by name" % nickname
     return create

@@ -1,0 +1,75 @@
+"""Torch interop (reference ``python/mxnet/torch.py``, modernized).
+
+The reference bridged lua-torch tensor functions into the NDArray
+namespace (``_th_*`` via the C API).  The modern equivalent exposes
+(py)torch over NDArray: ``mx.th.<fn>`` dispatches to ``torch.<fn>`` with
+NDArray↔Tensor conversion at the boundary, and ``to_torch``/
+``from_torch`` convert explicitly (host roundtrip — torch here is the
+CPU build; the TPU compute path stays jax/XLA).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ndarray import array as nd_array
+
+__all__ = ["to_torch", "from_torch", "th"]
+
+
+def _torch():
+    try:
+        import torch
+
+        return torch
+    except ImportError as exc:  # pragma: no cover - torch is baked in
+        raise MXNetError("torch is not installed") from exc
+
+
+def to_torch(arr: NDArray):
+    """NDArray → torch.Tensor (host COPY — ``asnumpy`` may return a
+    read-only view of the immutable XLA buffer, and an in-place torch op
+    on it would corrupt the source array behind jax's back)."""
+    import numpy as np
+
+    return _torch().from_numpy(np.array(arr.asnumpy()))
+
+
+def from_torch(tensor, ctx=None) -> NDArray:
+    """torch.Tensor → NDArray."""
+    return nd_array(tensor.detach().cpu().numpy(), ctx=ctx)
+
+
+def _wrap(value: Any):
+    torch = _torch()
+    if isinstance(value, torch.Tensor):
+        return from_torch(value)
+    if isinstance(value, (tuple, list)):
+        return type(value)(_wrap(v) for v in value)
+    return value
+
+
+class _TorchNamespace:
+    """``mx.th.<name>`` → ``torch.<name>`` with boundary conversion
+    (the reference registered every ``_th_`` function the same way)."""
+
+    def __getattr__(self, name: str):
+        torch = _torch()
+        fn = getattr(torch, name, None)
+        if fn is None or not callable(fn):
+            raise AttributeError("torch has no function %r" % name)
+
+        def wrapped(*args, **kwargs):
+            conv = [to_torch(a) if isinstance(a, NDArray) else a
+                    for a in args]
+            kconv = {k: to_torch(v) if isinstance(v, NDArray) else v
+                     for k, v in kwargs.items()}
+            return _wrap(fn(*conv, **kconv))
+
+        wrapped.__name__ = name
+        wrapped.__doc__ = "torch.%s over NDArray arguments" % name
+        return wrapped
+
+
+th = _TorchNamespace()

@@ -61,3 +61,29 @@ def test_libinfo():
 
     for p in paths:
         assert os.path.exists(p)
+
+
+def test_torch_bridge():
+    """mx.torch (reference python/mxnet/torch.py modernized): torch
+    functions over NDArray with boundary conversion."""
+    torch = pytest.importorskip("torch")
+    import numpy as np
+
+    a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    t = mx.torch.to_torch(a)
+    assert isinstance(t, torch.Tensor) and t.shape == (2, 3)
+    back = mx.torch.from_torch(t * 2)
+    np.testing.assert_array_equal(back.asnumpy(), a.asnumpy() * 2)
+
+    out = mx.torch.th.matmul(a, mx.nd.array(np.ones((3, 2),
+                                                    np.float32)))
+    assert isinstance(out, mx.nd.NDArray)
+    np.testing.assert_allclose(out.asnumpy(),
+                               a.asnumpy() @ np.ones((3, 2)))
+    # tuple-returning functions convert element-wise
+    vals, idx = mx.torch.th.sort(a, descending=True)
+    assert isinstance(vals, mx.nd.NDArray)
+    np.testing.assert_array_equal(vals.asnumpy(),
+                                  np.sort(a.asnumpy())[:, ::-1])
+    with pytest.raises(AttributeError):
+        mx.torch.th.not_a_torch_function
