@@ -15,16 +15,19 @@ from .lstm_ptb import get_symbol as lstm_ptb, lstm_ptb_sym_gen
 from .ssd import ssd_300, get_symbol_train as ssd_train, \
     get_symbol as ssd_deploy
 from . import rcnn
+from .transformer import get_symbol as transformer_lm
 
 __all__ = ["lenet", "mlp", "alexnet", "resnet", "vgg", "inception_bn",
            "lstm_ptb", "lstm_ptb_sym_gen", "ssd_300", "ssd_train",
-           "ssd_deploy", "get_symbol", "image_data_shape"]
+           "ssd_deploy", "transformer_lm", "get_symbol",
+           "image_data_shape"]
 
 
 _ZOO = {"lenet": lenet, "mlp": mlp, "alexnet": alexnet, "resnet": resnet,
         "vgg": vgg, "inception-bn": inception_bn,
         "inception_bn": inception_bn, "lstm_ptb": lstm_ptb,
-        "ssd_300": ssd_300, "ssd": ssd_300}
+        "ssd_300": ssd_300, "ssd": ssd_300,
+        "transformer_lm": transformer_lm, "transformer": transformer_lm}
 
 
 def get_symbol(network: str, **kwargs):

@@ -1,0 +1,93 @@
+"""Decoder-only transformer LM — the long-context flagship family.
+
+Not in the reference (v0.11 predates attention; its sequence family is
+the PTB LSTM, ``example/rnn``); included because long-context training
+is first-class here.  Pre-norm GPT-style blocks over the contrib
+attention op (``_contrib_DotProductAttention`` — Pallas flash kernel on
+TPU for lane-aligned shapes); trains through the standard paths
+(``Module.fit`` / ``FusedTrainStep``) like every other model family,
+and the sequence axis shards across chips via
+``parallel.sequence`` (ring/Ulysses) for contexts beyond one chip.
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+
+def _split_heads(x, batch, seq, heads, head_dim, name):
+    # (B, S, E) → (B, H, S, D)
+    r = sym.Reshape(x, shape=(batch, seq, heads, head_dim),
+                    name=name + "_split")
+    return sym.transpose(r, axes=(0, 2, 1, 3), name=name + "_bhsd")
+
+
+def _merge_heads(x, batch, seq, embed, name):
+    # (B, H, S, D) → (B, S, E)
+    t = sym.transpose(x, axes=(0, 2, 1, 3), name=name + "_bshd")
+    return sym.Reshape(t, shape=(batch, seq, embed), name=name + "_merge")
+
+
+def _block(x, batch, seq, embed, heads, name, causal=True):
+    head_dim = embed // heads
+    ln1 = sym.LayerNorm(x, axis=-1, name=name + "_ln1")
+    qkv = []
+    for part in ("q", "k", "v"):
+        p = sym.FullyConnected(ln1, num_hidden=embed, flatten=False,
+                               no_bias=True, name=name + "_" + part)
+        qkv.append(_split_heads(p, batch, seq, heads, head_dim,
+                                name + "_" + part))
+    att = sym.DotProductAttention(*qkv, causal=causal,
+                                  name=name + "_attn")
+    att = _merge_heads(att, batch, seq, embed, name + "_attn")
+    proj = sym.FullyConnected(att, num_hidden=embed, flatten=False,
+                              name=name + "_attn_proj")
+    x = x + proj
+
+    ln2 = sym.LayerNorm(x, axis=-1, name=name + "_ln2")
+    h = sym.FullyConnected(ln2, num_hidden=4 * embed, flatten=False,
+                           name=name + "_ffn1")
+    h = sym.Activation(h, act_type="relu", name=name + "_ffn_relu")
+    h = sym.FullyConnected(h, num_hidden=embed, flatten=False,
+                           name=name + "_ffn2")
+    return x + h
+
+
+def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
+               seq_len=64, batch_size=8, causal=True, **kwargs):
+    """Decoder-only LM.  Inputs ``data`` (B, S) int tokens and
+    ``softmax_label`` (B·S,) next-token targets; outputs per-position
+    softmax over the vocabulary.
+
+    Shapes are static (XLA contract) — batch/seq are build parameters,
+    mirroring how ``BucketingModule`` handled variable length in the
+    reference RNN family.
+    """
+    if embed % heads:
+        raise ValueError("embed (%d) must divide by heads (%d)"
+                         % (embed, heads))
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    tok = sym.Embedding(data, input_dim=vocab_size, output_dim=embed,
+                        name="tok_embed")
+    # learned positions: embed an arange via a constant-input trick is
+    # graph-unfriendly; use a position Variable-free Embedding over
+    # broadcast arange produced by the arange op
+    pos_ids = sym.arange(start=0, stop=seq_len, dtype="int32",
+                         name="pos_ids")
+    pos = sym.Embedding(pos_ids, input_dim=seq_len, output_dim=embed,
+                        name="pos_embed")
+    x = sym.broadcast_add(tok, sym.Reshape(pos, shape=(1, seq_len, embed),
+                                           name="pos_row"),
+                          name="embed_sum")
+    for i in range(num_layers):
+        x = _block(x, batch_size, seq_len, embed, heads,
+                   "block%d" % i, causal=causal)
+    x = sym.LayerNorm(x, axis=-1, name="ln_f")
+    x = sym.Reshape(x, shape=(batch_size * seq_len, embed),
+                    name="flatten_positions")
+    logits = sym.FullyConnected(x, num_hidden=vocab_size, name="lm_head")
+    # label comes in (B, S) like the PTB LSTM family and flattens to the
+    # positions axis inside the graph (lstm_ptb.py:45 convention), so
+    # Module's batch-axis slicing stays valid
+    label_flat = sym.Reshape(label, shape=(-1,), name="label_flat")
+    return sym.SoftmaxOutput(logits, label_flat, name="softmax")

@@ -59,3 +59,26 @@ def _count_sketch(ins, attrs, ctx):
     sign = s.reshape(-1)
     out = jnp.zeros((n, out_dim), dtype=data.dtype)
     return out.at[:, idx].add(data * sign[None, :])
+
+
+@register("_contrib_DotProductAttention",
+          arg_names=["query", "key", "value"],
+          aliases=["DotProductAttention"])
+def _dot_product_attention(ins, attrs, ctx):
+    """Multi-head scaled-dot-product attention over (B, H, S, D) inputs.
+
+    Not in the reference (v0.11 predates attention); provided as the
+    contrib building block of the transformer family.  Routes through
+    :func:`parallel.sequence.attention`: the Pallas flash kernel on TPU
+    for lane-aligned shapes, the materialized oracle elsewhere
+    (``impl`` attr: auto|flash|xla).
+    """
+    from ..parallel.sequence import attention
+    from .registry import parse_bool, parse_float
+
+    q, k, v = ins
+    causal = parse_bool(attrs.get("causal", False))
+    scale = attrs.get("scale")
+    scale = parse_float(scale) if scale is not None else None
+    impl = attrs.get("impl", "auto")
+    return attention(q, k, v, causal=causal, scale=scale, impl=impl)

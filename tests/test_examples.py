@@ -87,3 +87,10 @@ def test_train_rcnn_small():
                "--image-size", "64", "--batch-rois", "8",
                "--post-nms", "8")
     assert "done" in out and "bbox-loss" in out
+
+
+def test_train_transformer_lm():
+    out = _run("train_transformer_lm.py", "--num-epochs", "2",
+               "--seq-len", "16", "--num-batches", "4",
+               "--vocab-size", "16")
+    assert "Train-accuracy" in out and "done" in out
