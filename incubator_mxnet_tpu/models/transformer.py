@@ -53,7 +53,8 @@ def _block(x, batch, seq, embed, heads, name, causal=True):
 
 
 def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
-               seq_len=64, batch_size=8, causal=True, **kwargs):
+               seq_len=64, batch_size=8, causal=True, dtype="float32",
+               **kwargs):
     """Decoder-only LM.  Inputs ``data`` (B, S) int tokens and
     ``softmax_label`` (B·S,) next-token targets; outputs per-position
     softmax over the vocabulary.
@@ -79,6 +80,10 @@ def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
     x = sym.broadcast_add(tok, sym.Reshape(pos, shape=(1, seq_len, embed),
                                            name="pos_row"),
                           name="embed_sum")
+    if dtype in ("float16", "bfloat16"):
+        # bf16 activations (f32 masters stay f32 in FusedTrainStep);
+        # logits cast back before the softmax, like the CNN families
+        x = sym.Cast(x, dtype=dtype, name="to_lowp")
     for i in range(num_layers):
         x = _block(x, batch_size, seq_len, embed, heads,
                    "block%d" % i, causal=causal)
@@ -86,6 +91,8 @@ def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
     x = sym.Reshape(x, shape=(batch_size * seq_len, embed),
                     name="flatten_positions")
     logits = sym.FullyConnected(x, num_hidden=vocab_size, name="lm_head")
+    if dtype in ("float16", "bfloat16"):
+        logits = sym.Cast(logits, dtype="float32", name="logits_f32")
     # label comes in (B, S) like the PTB LSTM family and flattens to the
     # positions axis inside the graph (lstm_ptb.py:45 convention), so
     # Module's batch-axis slicing stays valid

@@ -175,3 +175,31 @@ def test_sequence_parallel_lm_step_matches_single_device():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_transformer_lm_bf16_forward():
+    """dtype='bfloat16' keeps f32 logits for the softmax and runs
+    numerically close to the f32 net on identical params."""
+    V, B, S = 20, 2, 8
+    kw = dict(vocab_size=V, embed=16, heads=2, num_layers=1,
+              seq_len=S, batch_size=B)
+    net32 = mx.models.transformer_lm(**kw)
+    net16 = mx.models.transformer_lm(dtype="bfloat16", **kw)
+    rng = np.random.RandomState(5)
+    shapes = dict(data=(B, S), softmax_label=(B, S))
+    ex32 = net32.simple_bind(grad_req="null", **shapes)
+    ex16 = net16.simple_bind(grad_req="null", **shapes)
+    for n in ex32.arg_dict:
+        if n in shapes:
+            continue
+        v = rng.uniform(-0.1, 0.1,
+                        ex32.arg_dict[n].shape).astype(np.float32)
+        ex32.arg_dict[n][:] = mx.nd.array(v)
+        ex16.arg_dict[n][:] = mx.nd.array(v)
+    toks = rng.randint(0, V, (B, S)).astype(np.float32)
+    for ex in (ex32, ex16):
+        ex.arg_dict["data"][:] = mx.nd.array(toks)
+    o32 = ex32.forward(is_train=False)[0].asnumpy()
+    o16 = ex16.forward(is_train=False)[0].asnumpy()
+    assert o16.dtype == np.float32  # logits cast back before softmax
+    np.testing.assert_allclose(o16, o32, rtol=0.08, atol=0.02)
