@@ -849,6 +849,8 @@ NN_COVERED = {
 # ops exercised (numeric asserts) by other dedicated test files
 COVERED_ELSEWHERE = {
     "Custom": "test_custom_op.py",
+    "_contrib_DotProductAttention": "test_transformer.py",
+    "DotProductAttention": "test_transformer.py",
     "Correlation": "test_contrib_vision.py",
     "_contrib_CTCLoss": "test_contrib_vision.py",
     "CTCLoss": "test_contrib_vision.py",
