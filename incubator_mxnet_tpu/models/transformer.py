@@ -27,7 +27,8 @@ def _merge_heads(x, batch, seq, embed, name):
     return sym.Reshape(t, shape=(batch, seq, embed), name=name + "_merge")
 
 
-def _block(x, batch, seq, embed, heads, name, causal=True):
+def _block(x, batch, seq, embed, heads, name, causal=True,
+           attn_impl="auto"):
     head_dim = embed // heads
     ln1 = sym.LayerNorm(x, axis=-1, name=name + "_ln1")
     qkv = []
@@ -36,7 +37,7 @@ def _block(x, batch, seq, embed, heads, name, causal=True):
                                no_bias=True, name=name + "_" + part)
         qkv.append(_split_heads(p, batch, seq, heads, head_dim,
                                 name + "_" + part))
-    att = sym.DotProductAttention(*qkv, causal=causal,
+    att = sym.DotProductAttention(*qkv, causal=causal, impl=attn_impl,
                                   name=name + "_attn")
     att = _merge_heads(att, batch, seq, embed, name + "_attn")
     proj = sym.FullyConnected(att, num_hidden=embed, flatten=False,
@@ -54,7 +55,7 @@ def _block(x, batch, seq, embed, heads, name, causal=True):
 
 def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
                seq_len=64, batch_size=8, causal=True, dtype="float32",
-               **kwargs):
+               attn_impl="auto", **kwargs):
     """Decoder-only LM.  Inputs ``data`` (B, S) int tokens and
     ``softmax_label`` (B·S,) next-token targets; outputs per-position
     softmax over the vocabulary.
@@ -86,7 +87,7 @@ def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
         x = sym.Cast(x, dtype=dtype, name="to_lowp")
     for i in range(num_layers):
         x = _block(x, batch_size, seq_len, embed, heads,
-                   "block%d" % i, causal=causal)
+                   "block%d" % i, causal=causal, attn_impl=attn_impl)
     x = sym.LayerNorm(x, axis=-1, name="ln_f")
     x = sym.Reshape(x, shape=(batch_size * seq_len, embed),
                     name="flatten_positions")

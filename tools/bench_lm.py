@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Transformer LM training benchmark (tokens/s, readback-fenced).
+
+The long-context counterpart of ``bench.py`` (PERF.md §8c): a decoder-
+only LM through ``FusedTrainStep``, attention on the Pallas flash kernel
+for lane-aligned shapes.  Prints one JSON line.
+
+Env: TP_LM_BATCH (8), TP_LM_SEQ (2048), TP_LM_EMBED (512),
+TP_LM_LAYERS (4), TP_LM_VOCAB (32000), TP_LM_STEPS (10),
+TP_LM_DTYPE (bfloat16), TP_LM_SMALL=1 (CPU smoke).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    small = os.environ.get("TP_LM_SMALL") == "1"
+    B = int(os.environ.get("TP_LM_BATCH", "2" if small else "8"))
+    S = int(os.environ.get("TP_LM_SEQ", "16" if small else "2048"))
+    E = int(os.environ.get("TP_LM_EMBED", "32" if small else "512"))
+    L = int(os.environ.get("TP_LM_LAYERS", "1" if small else "4"))
+    V = int(os.environ.get("TP_LM_VOCAB", "64" if small else "32000"))
+    steps = int(os.environ.get("TP_LM_STEPS", "2" if small else "10"))
+    dtype = os.environ.get("TP_LM_DTYPE",
+                           "float32" if small else "bfloat16")
+
+    import jax
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+
+    net = mx.models.transformer_lm(
+        vocab_size=V, embed=E, heads=max(1, E // 128) if not small else 2,
+        num_layers=L, seq_len=S, batch_size=B, dtype=dtype)
+    step = parallel.FusedTrainStep(
+        net, {"data": (B, S)}, {"softmax_label": (B, S)},
+        mesh=parallel.default_mesh(1), optimizer="adam",
+        optimizer_params={"learning_rate": 1e-3},
+        initializer=mx.initializer.Xavier())
+
+    rng = np.random.RandomState(0)
+    bd = {"data": jax.device_put(
+        rng.randint(0, V, (B, S)).astype(np.float32)),
+        "softmax_label": jax.device_put(
+            ((rng.randint(0, V, (B, S)) + 1) % V).astype(np.float32))}
+
+    # fence on the SMALLEST parameter: the readback crosses the slow
+    # D2H tunnel, and the first param here is the 65 MB embedding —
+    # reading it would measure the tunnel, not the step (PERF.md §1)
+    name = min(step.params, key=lambda n: step.params[n].size)
+
+    def sync():
+        return float(np.asarray(step.params[name]).ravel()[0])
+
+    step(bd)
+    step(bd)
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        step(bd)
+    sync()
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "transformer_lm_train_tokens_per_sec",
+        "value": round(B * S * steps / dt, 1),
+        "unit": "tokens/s",
+        "batch": B, "seq_len": S, "embed": E, "layers": L,
+        "vocab": V, "dtype": dtype}))
+
+
+if __name__ == "__main__":
+    main()
