@@ -32,12 +32,7 @@ BASELINE_IMG_S = 181.53  # P100 ResNet-50 train b32 (docs/how_to/perf.md)
 
 
 def _sync(step):
-    """True execution fence: pull one scalar that depends on the latest
-    parameter update back to the host.  Fences on the SMALLEST parameter
-    — every param updates in the same XLA program, and reading a large
-    one would measure the slow D2H tunnel instead of the step."""
-    name = min(step.params, key=lambda n: step.params[n].size)
-    return float(np.asarray(step.params[name]).ravel()[0])
+    return step.sync()  # smallest-param readback fence (FusedTrainStep)
 
 
 def main():

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Train a DCGAN on synthetic images (reference ``example/gan/dcgan.py``)::
+
+    python examples/train_dcgan.py --size 32 --num-epochs 2
+
+The adversarial loop is the reference's exactly: the discriminator
+module trains on real then fake batches, and the generator module
+receives the discriminator's INPUT gradient through
+``Module.backward(out_grads=...)`` — the external-gradient API.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import common  # noqa: E402,F401  (TP_EXAMPLES_FORCE_CPU device pin)
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu.io import DataBatch  # noqa: E402
+from incubator_mxnet_tpu.models import dcgan  # noqa: E402
+
+
+def real_batches(rng, n, batch, nc, size):
+    """Synthetic 'real' data: smooth blobs in [-1, 1] (tanh range)."""
+    for _ in range(n):
+        base = rng.randn(batch, nc, 4, 4)
+        img = np.repeat(np.repeat(base, size // 4, 2), size // 4, 3)
+        yield np.tanh(img).astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser(description="Train DCGAN")
+    ap.add_argument("--size", type=int, default=32, choices=(32, 64))
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--z-dim", type=int, default=16)
+    ap.add_argument("--ngf", type=int, default=16)
+    ap.add_argument("--ndf", type=int, default=16)
+    ap.add_argument("--nc", type=int, default=3)
+    ap.add_argument("--num-epochs", type=int, default=2)
+    ap.add_argument("--num-batches", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=2e-4)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    B, Z, nc, size = args.batch_size, args.z_dim, args.nc, args.size
+    gen_sym, disc_sym = dcgan.make_dcgan_sym(ngf=args.ngf, ndf=args.ndf,
+                                             nc=nc, size=size)
+
+    mx.random.seed(0)
+    gen = mx.mod.Module(gen_sym, data_names=("rand",), label_names=(),
+                        context=mx.cpu())
+    gen.bind(data_shapes=[("rand", (B, Z, 1, 1))])
+    gen.init_params(mx.initializer.Normal(0.02))
+    gen.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "beta1": 0.5})
+    disc = mx.mod.Module(disc_sym, data_names=("data",),
+                         label_names=("label",), context=mx.cpu())
+    disc.bind(data_shapes=[("data", (B, nc, size, size))],
+              label_shapes=[("label", (B, 1))],
+              inputs_need_grad=True)
+    disc.init_params(mx.initializer.Normal(0.02))
+    disc.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": args.lr,
+                                          "beta1": 0.5})
+
+    rng = np.random.RandomState(0)
+    ones = mx.nd.array(np.ones((B, 1), np.float32))
+    zeros = mx.nd.array(np.zeros((B, 1), np.float32))
+
+    for epoch in range(args.num_epochs):
+        dls, gls = [], []
+        for real in real_batches(rng, args.num_batches, B, nc, size):
+            noise = rng.randn(B, Z, 1, 1).astype(np.float32)
+            gen.forward(DataBatch([mx.nd.array(noise)], []),
+                        is_train=True)
+            fake = gen.get_outputs()[0]
+
+            # --- discriminator: fake batch (label 0) ------------------
+            disc.forward(DataBatch([fake.copy()], [zeros]),
+                         is_train=True)
+            disc.backward()
+            grads_fake = [
+                [g.copy() for g in glist]
+                for glist in disc._exec_group.grad_arrays]
+            # --- discriminator: real batch (label 1) ------------------
+            disc.forward(DataBatch([mx.nd.array(real)], [ones]),
+                         is_train=True)
+            disc.backward()
+            # accumulate fake-pass grads (reference gradmod pattern)
+            for glist, flist in zip(disc._exec_group.grad_arrays,
+                                    grads_fake):
+                for g, f in zip(glist, flist):
+                    g += f
+            disc.update()
+            dls.append(float(disc.get_outputs()[0].asnumpy().mean()))
+
+            # --- generator: fool the discriminator (label 1) ----------
+            disc.forward(DataBatch([fake], [ones]), is_train=True)
+            disc.backward()
+            diff = disc.get_input_grads()[0]
+            gen.backward([diff])          # external out_grads
+            gen.update()
+            gls.append(float(disc.get_outputs()[0].asnumpy().mean()))
+        logging.info("Epoch[%d] D(real-pass out)=%.3f D(G(z))=%.3f",
+                     epoch, np.mean(dls), np.mean(gls))
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

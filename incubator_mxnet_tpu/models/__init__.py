@@ -16,6 +16,7 @@ from .ssd import ssd_300, get_symbol_train as ssd_train, \
     get_symbol as ssd_deploy
 from . import rcnn
 from .transformer import get_symbol as transformer_lm
+from . import dcgan
 
 __all__ = ["lenet", "mlp", "alexnet", "resnet", "vgg", "inception_bn",
            "lstm_ptb", "lstm_ptb_sym_gen", "ssd_300", "ssd_train",

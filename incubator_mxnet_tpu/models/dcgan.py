@@ -1,0 +1,61 @@
+"""DCGAN generator/discriminator (reference ``example/gan/dcgan.py``
+``make_dcgan_sym``): the adversarial-training example family, and the
+exerciser of ``Deconvolution`` + external-gradient ``Module.backward``.
+
+``size`` scales the image (64 = the reference's 64×64; 32 drops one
+up/down block for fast smoke runs).
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+
+def make_dcgan_sym(ngf=64, ndf=64, nc=3, size=64, no_bias=True,
+                   fix_gamma=True, eps=1e-5 + 1e-12):
+    """-> (generator_sym, discriminator_sym).
+
+    Generator: rand (B, Z, 1, 1) → tanh image (B, nc, size, size).
+    Discriminator: image → logistic real/fake loss vs ``label``.
+    """
+    assert size in (32, 64), "size must be 32 or 64"
+    n_up = 3 if size == 32 else 4
+    BatchNorm = sym.BatchNorm
+
+    rand = sym.Variable("rand")
+    g = sym.Deconvolution(rand, name="g1", kernel=(4, 4),
+                          num_filter=ngf * 2 ** n_up // 2,
+                          no_bias=no_bias)
+    g = BatchNorm(g, name="gbn1", fix_gamma=fix_gamma, eps=eps)
+    g = sym.Activation(g, name="gact1", act_type="relu")
+    for i in range(n_up - 1):
+        filt = ngf * 2 ** (n_up - 2 - i)
+        g = sym.Deconvolution(g, name="g%d" % (i + 2), kernel=(4, 4),
+                              stride=(2, 2), pad=(1, 1),
+                              num_filter=filt, no_bias=no_bias)
+        g = BatchNorm(g, name="gbn%d" % (i + 2), fix_gamma=fix_gamma,
+                      eps=eps)
+        g = sym.Activation(g, name="gact%d" % (i + 2), act_type="relu")
+    g = sym.Deconvolution(g, name="g%d" % (n_up + 1), kernel=(4, 4),
+                          stride=(2, 2), pad=(1, 1), num_filter=nc,
+                          no_bias=no_bias)
+    gout = sym.Activation(g, name="gact_out", act_type="tanh")
+
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    d = sym.Convolution(data, name="d1", kernel=(4, 4), stride=(2, 2),
+                        pad=(1, 1), num_filter=ndf, no_bias=no_bias)
+    d = sym.LeakyReLU(d, name="dact1", act_type="leaky", slope=0.2)
+    for i in range(n_up - 1):
+        d = sym.Convolution(d, name="d%d" % (i + 2), kernel=(4, 4),
+                            stride=(2, 2), pad=(1, 1),
+                            num_filter=ndf * 2 ** (i + 1),
+                            no_bias=no_bias)
+        d = BatchNorm(d, name="dbn%d" % (i + 2), fix_gamma=fix_gamma,
+                      eps=eps)
+        d = sym.LeakyReLU(d, name="dact%d" % (i + 2), act_type="leaky",
+                          slope=0.2)
+    d = sym.Convolution(d, name="d%d" % (n_up + 1), kernel=(4, 4),
+                        num_filter=1, no_bias=no_bias)
+    d = sym.Flatten(d)
+    dloss = sym.LogisticRegressionOutput(d, label, name="dloss")
+    return gout, dloss

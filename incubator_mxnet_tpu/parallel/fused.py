@@ -258,6 +258,19 @@ class FusedTrainStep:
             jnp.float32(lr), jnp.float32(self.num_update), vals)
         return outs
 
+    # -------------------------------------------------------------- fence
+    def sync(self) -> float:
+        """True execution fence: host-read one scalar that depends on
+        the latest parameter update.  Uses the SMALLEST parameter —
+        every param updates in the same XLA program, so any one fences
+        the step, and a large readback would measure the (slow, on some
+        platforms wildly variable) D2H path instead (PERF.md §1, §8c).
+        """
+        import numpy as np
+
+        name = min(self.params, key=lambda n: self.params[n].size)
+        return float(np.asarray(self.params[name]).ravel()[0])
+
     # ------------------------------------------------------------- params
     def get_params(self):
         """Gather to host as NDArray dicts (Module-compatible)."""

@@ -94,3 +94,9 @@ def test_train_transformer_lm():
                "--seq-len", "16", "--num-batches", "4",
                "--vocab-size", "16")
     assert "Train-accuracy" in out and "done" in out
+
+
+def test_train_dcgan():
+    out = _run("train_dcgan.py", "--num-epochs", "1",
+               "--num-batches", "2", "--size", "32")
+    assert "done" in out and "D(G(z))" in out

@@ -63,3 +63,16 @@ def test_resnet20_cifar_forward():
     out = ex.outputs[0].asnumpy()
     assert out.shape == (2, 10)
     np.testing.assert_allclose(out.sum(1), np.ones(2), rtol=1e-4)
+
+
+def test_dcgan_symbols():
+    """DCGAN generator/discriminator shapes (reference
+    example/gan/dcgan.py make_dcgan_sym)."""
+    from incubator_mxnet_tpu.models import dcgan
+
+    for size in (32, 64):
+        g, d = dcgan.make_dcgan_sym(ngf=8, ndf=8, nc=3, size=size)
+        _, go, _ = g.infer_shape(rand=(2, 4, 1, 1))
+        assert go == [(2, 3, size, size)]
+        _, do, _ = d.infer_shape(data=(2, 3, size, size), label=(2, 1))
+        assert do == [(2, 1)]

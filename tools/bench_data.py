@@ -165,9 +165,7 @@ def fed_training(prefix: str, data_shape, batch_size: int, steps: int,
 
 
 def _sync(step):
-    # smallest param: a large readback would measure the D2H tunnel
-    name = min(step.params, key=lambda n: step.params[n].size)
-    return float(np.asarray(step.params[name]).ravel()[0])
+    return step.sync()  # smallest-param readback fence (FusedTrainStep)
 
 
 def main():

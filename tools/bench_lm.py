@@ -38,8 +38,14 @@ def main():
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import parallel
 
+    if small:
+        heads = 2
+    else:
+        # largest head count with ~128-wide heads that divides embed
+        heads = next(h for h in range(max(1, E // 128), 0, -1)
+                     if E % h == 0)
     net = mx.models.transformer_lm(
-        vocab_size=V, embed=E, heads=max(1, E // 128) if not small else 2,
+        vocab_size=V, embed=E, heads=heads,
         num_layers=L, seq_len=S, batch_size=B, dtype=dtype)
     step = parallel.FusedTrainStep(
         net, {"data": (B, S)}, {"softmax_label": (B, S)},
@@ -53,13 +59,7 @@ def main():
         "softmax_label": jax.device_put(
             ((rng.randint(0, V, (B, S)) + 1) % V).astype(np.float32))}
 
-    # fence on the SMALLEST parameter: the readback crosses the slow
-    # D2H tunnel, and the first param here is the 65 MB embedding —
-    # reading it would measure the tunnel, not the step (PERF.md §1)
-    name = min(step.params, key=lambda n: step.params[n].size)
-
-    def sync():
-        return float(np.asarray(step.params[name]).ravel()[0])
+    sync = step.sync  # smallest-param readback fence (FusedTrainStep)
 
     step(bd)
     step(bd)
