@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Matrix-factorization recommender (reference ``example/recommenders``
+demo1-MF: user/item embeddings, dot-product score, L2 loss)::
+
+    python examples/train_matrix_fact.py --num-epochs 8
+
+Synthetic ratings come from a planted low-rank model, so train RMSE
+must drop well below the rating scale — the driver doubles as a
+correctness check.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import common  # noqa: E402,F401  (TP_EXAMPLES_FORCE_CPU device pin)
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu.io import DataBatch  # noqa: E402
+
+
+def mf_symbol(num_users, num_items, factor=16):
+    """score(u, i) = <user_emb[u], item_emb[i]> (reference plain_net)."""
+    user = mx.sym.Variable("user")
+    item = mx.sym.Variable("item")
+    score = mx.sym.Variable("score")
+    u = mx.sym.Embedding(user, input_dim=num_users, output_dim=factor,
+                         name="user_embed")
+    v = mx.sym.Embedding(item, input_dim=num_items, output_dim=factor,
+                         name="item_embed")
+    pred = mx.sym.sum(u * v, axis=1, name="dot")
+    return mx.sym.LinearRegressionOutput(pred, score, name="lro")
+
+
+def main():
+    ap = argparse.ArgumentParser(description="Train MF recommender")
+    ap.add_argument("--num-users", type=int, default=64)
+    ap.add_argument("--num-items", type=int, default=48)
+    ap.add_argument("--factor", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=8)
+    ap.add_argument("--num-ratings", type=int, default=2048)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    # planted low-rank ground truth
+    gt_u = rng.randn(args.num_users, args.factor) * 0.5
+    gt_v = rng.randn(args.num_items, args.factor) * 0.5
+    users = rng.randint(0, args.num_users, args.num_ratings)
+    items = rng.randint(0, args.num_items, args.num_ratings)
+    scores = (np.einsum("nf,nf->n", gt_u[users], gt_v[items])
+              + rng.randn(args.num_ratings) * 0.05).astype(np.float32)
+
+    net = mf_symbol(args.num_users, args.num_items, args.factor)
+    mx.random.seed(1)
+    mod = mx.mod.Module(net, data_names=("user", "item"),
+                        label_names=("score",), context=mx.cpu())
+    B = args.batch_size
+    mod.bind(data_shapes=[("user", (B,)), ("item", (B,))],
+             label_shapes=[("score", (B,))])
+    mod.init_params(mx.initializer.Normal(0.3))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+
+    n_batches = args.num_ratings // B
+    if n_batches == 0:
+        ap.error("--num-ratings (%d) must be >= --batch-size (%d)"
+                 % (args.num_ratings, B))
+    rmse = float("nan")
+    for epoch in range(args.num_epochs):
+        se = 0.0
+        order = rng.permutation(args.num_ratings)[:n_batches * B]
+        for b in range(n_batches):
+            sel = order[b * B:(b + 1) * B]
+            batch = DataBatch(
+                [mx.nd.array(users[sel].astype(np.float32)),
+                 mx.nd.array(items[sel].astype(np.float32))],
+                [mx.nd.array(scores[sel])])
+            mod.forward_backward(batch)
+            mod.update()
+            pred = mod.get_outputs()[0].asnumpy()
+            se += float(((pred - scores[sel]) ** 2).sum())
+        rmse = np.sqrt(se / (n_batches * B))
+        logging.info("Epoch[%d] Train-RMSE=%.4f", epoch, rmse)
+    print("final-rmse=%.4f" % rmse)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

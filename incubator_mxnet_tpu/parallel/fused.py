@@ -266,8 +266,6 @@ class FusedTrainStep:
         the step, and a large readback would measure the (slow, on some
         platforms wildly variable) D2H path instead (PERF.md §1, §8c).
         """
-        import numpy as np
-
         name = min(self.params, key=lambda n: self.params[n].size)
         return float(np.asarray(self.params[name]).ravel()[0])
 

@@ -100,3 +100,11 @@ def test_train_dcgan():
     out = _run("train_dcgan.py", "--num-epochs", "1",
                "--num-batches", "2", "--size", "32")
     assert "done" in out and "D(G(z))" in out
+
+
+def test_train_matrix_fact():
+    out = _run("train_matrix_fact.py", "--num-epochs", "6",
+               "--num-ratings", "1024")
+    assert "final-rmse=" in out
+    rmse = float(out.split("final-rmse=")[1].split()[0])
+    assert rmse < 0.5, rmse  # planted low-rank model is learnable
