@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Train a fully-connected autoencoder on (synthetic) MNIST
+(reference ``example/autoencoder``: stacked AE, here trained end-to-end
+with the same 784-500-250-2-250-500-784 shape)::
+
+    python examples/train_autoencoder.py --num-epochs 4
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import common  # noqa: E402,F401  (TP_EXAMPLES_FORCE_CPU device pin)
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu.io import DataBatch  # noqa: E402
+
+
+def ae_symbol(dims=(784, 500, 250, 2)):
+    """Encoder stack + mirrored decoder, L2 reconstruction loss
+    (reference ``autoencoder.py`` make_encoder/make_decoder)."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("target")
+    x = data
+    for i, d in enumerate(dims[1:]):
+        x = mx.sym.FullyConnected(x, num_hidden=d, name="enc%d" % i)
+        if i < len(dims) - 2:
+            x = mx.sym.Activation(x, act_type="relu",
+                                  name="enc%d_relu" % i)
+    for i, d in enumerate(reversed(dims[:-1])):
+        x = mx.sym.FullyConnected(x, num_hidden=d, name="dec%d" % i)
+        if i < len(dims) - 2:
+            x = mx.sym.Activation(x, act_type="relu",
+                                  name="dec%d_relu" % i)
+    return mx.sym.LinearRegressionOutput(x, label, name="recon")
+
+
+def main():
+    ap = argparse.ArgumentParser(description="Train an autoencoder")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=4)
+    ap.add_argument("--num-examples", type=int, default=1024)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    mx.random.seed(0)  # before the iterator: its shuffle draws from
+    # the global numpy stream, so seeding after would leave run-to-run
+    # nondeterminism in the epoch order
+    it = mx.io.MNISTIter(batch_size=args.batch_size, flat=True,
+                         num_examples=args.num_examples, seed=0)
+    net = ae_symbol()
+    mx.random.seed(0)
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("target",), context=mx.cpu())
+    B = args.batch_size
+    mod.bind(data_shapes=[("data", (B, 784))],
+             label_shapes=[("target", (B, 784))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+
+    mse = float("nan")
+    for epoch in range(args.num_epochs):
+        se = n = 0
+        it.reset()
+        for batch in it:
+            x = batch.data[0]
+            mod.forward_backward(DataBatch([x], [x]))
+            mod.update()
+            valid = x.shape[0] - batch.pad  # wrap-around padding rows
+            rec = mod.get_outputs()[0].asnumpy()[:valid]
+            se += float(((rec - x.asnumpy()[:valid]) ** 2).sum())
+            n += rec.size
+        mse = se / n
+        logging.info("Epoch[%d] Train-MSE=%.5f", epoch, mse)
+    print("final-mse=%.5f" % mse)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Multi-task training: one trunk, two heads (reference
+``example/multi-task``: MNIST digit + synthetic parity label)::
+
+    python examples/train_multi_task.py --num-epochs 3
+
+Exercises the multi-output Module path: ``sym.Group`` of two
+``SoftmaxOutput`` heads, two labels, and a per-head metric.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import common  # noqa: E402,F401  (TP_EXAMPLES_FORCE_CPU device pin)
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu.io import DataBatch  # noqa: E402
+
+
+def multitask_symbol(num_digits=10):
+    data = mx.sym.Variable("data")
+    d_label = mx.sym.Variable("digit_label")
+    p_label = mx.sym.Variable("parity_label")
+    x = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    x = mx.sym.Activation(x, act_type="relu", name="relu1")
+    trunk = mx.sym.FullyConnected(x, num_hidden=64, name="fc2")
+    trunk = mx.sym.Activation(trunk, act_type="relu", name="relu2")
+    digit = mx.sym.FullyConnected(trunk, num_hidden=num_digits,
+                                  name="digit_fc")
+    digit = mx.sym.SoftmaxOutput(digit, d_label, name="digit")
+    parity = mx.sym.FullyConnected(trunk, num_hidden=2, name="parity_fc")
+    parity = mx.sym.SoftmaxOutput(parity, p_label, name="parity")
+    return mx.sym.Group([digit, parity])
+
+
+def main():
+    ap = argparse.ArgumentParser(description="Multi-task training")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--num-examples", type=int, default=1024)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    mx.random.seed(0)  # before the iterator: its shuffle draws from
+    # the global numpy stream, so seeding after would leave run-to-run
+    # nondeterminism in the epoch order
+    it = mx.io.MNISTIter(batch_size=args.batch_size, flat=True,
+                         num_examples=args.num_examples, seed=0)
+    net = multitask_symbol()
+    mx.random.seed(0)
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("digit_label", "parity_label"),
+                        context=mx.cpu())
+    B = args.batch_size
+    mod.bind(data_shapes=[("data", (B, 784))],
+             label_shapes=[("digit_label", (B,)),
+                           ("parity_label", (B,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "momentum": 0.9})
+
+    acc_d = acc_p = 0.0
+    for epoch in range(args.num_epochs):
+        cd = cp = n = 0
+        it.reset()
+        for batch in it:
+            digits = batch.label[0].asnumpy()
+            parity = (digits % 2).astype(np.float32)
+            mod.forward_backward(DataBatch(
+                batch.data, [batch.label[0], mx.nd.array(parity)]))
+            mod.update()
+            outs = [o.asnumpy() for o in mod.get_outputs()]
+            valid = len(digits) - batch.pad  # wrap-around padding rows
+            cd += (outs[0].argmax(1) == digits)[:valid].sum()
+            cp += (outs[1].argmax(1) == parity)[:valid].sum()
+            n += valid
+        acc_d, acc_p = cd / n, cp / n
+        logging.info("Epoch[%d] digit-acc=%.3f parity-acc=%.3f",
+                     epoch, acc_d, acc_p)
+    print("digit-acc=%.3f parity-acc=%.3f" % (acc_d, acc_p))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Text classification with a Kim-style CNN (reference
+``example/cnn_text_classification``)::
+
+    python examples/train_text_cnn.py --num-epochs 4
+
+Embedding → parallel convolutions over n-gram windows → max-pool →
+concat → dropout → softmax.  Synthetic task: a sentence is positive iff
+it contains the token bigram (3, 7) — learnable only through the
+n-gram filters.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import common  # noqa: E402,F401  (TP_EXAMPLES_FORCE_CPU device pin)
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu.io import DataBatch  # noqa: E402
+
+
+def text_cnn_symbol(vocab_size, seq_len, embed=32, filters=(2, 3, 4),
+                    num_filter=16, num_classes=2, dropout=0.5):
+    """Reference ``text_cnn.py`` sym_gen."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    emb = mx.sym.Embedding(data, input_dim=vocab_size, output_dim=embed,
+                           name="embed")
+    # (B, S, E) -> (B, 1, S, E): conv over the n-gram (time) axis
+    x = mx.sym.Reshape(emb, shape=(0, 1, seq_len, embed), name="to_nchw")
+    pooled = []
+    for f in filters:
+        c = mx.sym.Convolution(x, kernel=(f, embed),
+                               num_filter=num_filter,
+                               name="conv%d" % f)
+        c = mx.sym.Activation(c, act_type="relu", name="relu%d" % f)
+        p = mx.sym.Pooling(c, pool_type="max",
+                           kernel=(seq_len - f + 1, 1),
+                           name="pool%d" % f)
+        pooled.append(p)
+    h = mx.sym.Concat(*pooled, dim=1, name="concat")
+    h = mx.sym.Flatten(h)
+    if dropout > 0:
+        h = mx.sym.Dropout(h, p=dropout, name="drop")
+    fc = mx.sym.FullyConnected(h, num_hidden=num_classes, name="cls")
+    return mx.sym.SoftmaxOutput(fc, label, name="softmax")
+
+
+def make_data(rng, n, vocab, seq_len):
+    toks = rng.randint(0, vocab, (n, seq_len))
+    labels = np.zeros(n, np.float32)
+    for i in range(n):
+        if rng.rand() < 0.5:   # plant the positive bigram
+            pos = rng.randint(0, seq_len - 1)
+            toks[i, pos], toks[i, pos + 1] = 3, 7
+        has = any(toks[i, j] == 3 and toks[i, j + 1] == 7
+                  for j in range(seq_len - 1))
+        labels[i] = float(has)
+    return toks.astype(np.float32), labels
+
+
+def main():
+    ap = argparse.ArgumentParser(description="Train a text CNN")
+    ap.add_argument("--vocab-size", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-epochs", type=int, default=4)
+    ap.add_argument("--num-examples", type=int, default=1024)
+    ap.add_argument("--lr", type=float, default=5e-3)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    toks, labels = make_data(rng, args.num_examples, args.vocab_size,
+                             args.seq_len)
+    net = text_cnn_symbol(args.vocab_size, args.seq_len)
+    mx.random.seed(0)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    B = args.batch_size
+    mod.bind(data_shapes=[("data", (B, args.seq_len))],
+             label_shapes=[("softmax_label", (B,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+
+    n_batches = args.num_examples // B
+    if n_batches == 0:
+        ap.error("--num-examples (%d) must be >= --batch-size (%d)"
+                 % (args.num_examples, B))
+    acc = 0.0
+    for epoch in range(args.num_epochs):
+        correct = 0
+        for b in range(n_batches):
+            sl = slice(b * B, (b + 1) * B)
+            mod.forward_backward(DataBatch(
+                [mx.nd.array(toks[sl])], [mx.nd.array(labels[sl])]))
+            mod.update()
+            pred = mod.get_outputs()[0].asnumpy().argmax(1)
+            correct += (pred == labels[sl]).sum()
+        acc = correct / (n_batches * B)
+        logging.info("Epoch[%d] Train-accuracy=%.3f", epoch, acc)
+    print("final-acc=%.3f" % acc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,3 +108,27 @@ def test_train_matrix_fact():
     assert "final-rmse=" in out
     rmse = float(out.split("final-rmse=")[1].split()[0])
     assert rmse < 0.5, rmse  # planted low-rank model is learnable
+
+
+def test_train_autoencoder():
+    out = _run("train_autoencoder.py", "--num-epochs", "3",
+               "--num-examples", "256")
+    assert "final-mse=" in out
+    mse = float(out.rsplit("final-mse=", 1)[1].split()[0])
+    assert mse < 0.15, mse  # epoch 0 starts >0.2; learning must show
+
+
+def test_train_multi_task():
+    out = _run("train_multi_task.py", "--num-epochs", "5",
+               "--num-examples", "512")
+    assert "parity-acc=" in out
+    acc = float(out.rsplit("parity-acc=", 1)[1].split()[0])
+    assert acc > 0.9, acc
+
+
+def test_train_text_cnn():
+    out = _run("train_text_cnn.py", "--num-epochs", "5",
+               "--num-examples", "512")
+    assert "final-acc=" in out
+    acc = float(out.split("final-acc=")[1].split()[0])
+    assert acc > 0.85, acc
