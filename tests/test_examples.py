@@ -132,3 +132,11 @@ def test_train_text_cnn():
     assert "final-acc=" in out
     acc = float(out.split("final-acc=")[1].split()[0])
     assert acc > 0.85, acc
+
+
+def test_train_bi_lstm_sort():
+    out = _run("train_bi_lstm_sort.py", "--num-epochs", "4",
+               "--num-examples", "512")
+    assert "final-acc=" in out
+    acc = float(out.rsplit("final-acc=", 1)[1].split()[0])
+    assert acc > 0.5, acc  # chance is 1/16; bidirectional context needed
