@@ -470,10 +470,12 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False,
     no float cast, no host-side color math) — the ImageRecordUInt8Iter
     configuration where normalization belongs on the device."""
     if not cast:
-        assert mean is None and std is None and not (
-            brightness or contrast or saturation or hue or pca_noise
-            or rand_gray), \
-            "cast=False keeps color math off the host pipeline"
+        if mean is not None or std is not None or (
+                brightness or contrast or saturation or hue or pca_noise
+                or rand_gray):
+            raise MXNetError(
+                "cast=False keeps color math off the host pipeline; "
+                "mean/std/jitter arguments would be silently dropped")
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))

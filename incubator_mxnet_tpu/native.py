@@ -35,13 +35,22 @@ def _build() -> bool:
     if os.path.exists(_SO) and \
             os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return True
+    # Compile to a private temp path and rename into place: rename is
+    # atomic on POSIX, so concurrent builders (tools/launch.py local
+    # mode, parallel test runs) never dlopen a half-written .so.
+    tmp = "%s.%d" % (_SO, os.getpid())
     try:
         subprocess.check_call(
             ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
-             "-o", _SO, _SRC],
+             "-o", tmp, _SRC],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        os.rename(tmp, _SO)
         return True
     except (OSError, subprocess.CalledProcessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

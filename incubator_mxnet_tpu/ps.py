@@ -444,10 +444,14 @@ class PSServer(_Node):
         cmd = msg["cmd"]
         if cmd == "init":
             with self._lock:
-                # on a recovered server the FIRST re-seed wins: later
-                # (staler) worker copies must not roll back updates already
-                # applied on top of the first seed
-                if not (self.recovery and msg["key"] in self._store):
+                # recovery re-seeds are tagged by the worker: the FIRST
+                # re-seed wins — later (staler) copies from workers that
+                # trip on the dead server afterwards must not roll back
+                # updates already applied on top of the first seed.
+                # Untagged (ordinary) inits always apply, so a legitimate
+                # re-init behaves identically on healthy and replaced
+                # servers and shard state cannot diverge.
+                if not (msg.get("reseed") and msg["key"] in self._store):
                     self._store[msg["key"]] = np.array(msg["value"],
                                                        dtype=np.float32)
             return {"status": "ok"}
@@ -660,6 +664,7 @@ class PSClient:
                 if si in replaced:
                     self._pool.rpc(self.servers[si],
                                    {"cmd": "init", "key": subkey,
+                                    "reseed": True,
                                     "value": value[sl]})
 
     # ------------------------------------------------------------------- api

@@ -269,6 +269,7 @@ def scan_record_starts(uri: str):
         offsets, _ = scanned
         return [int(o) - 8 for o in offsets]  # payload → header start
     starts = []
+    fsize = os.path.getsize(uri)
     with open(uri, "rb") as f:
         while True:
             pos = f.tell()
@@ -278,10 +279,14 @@ def scan_record_starts(uri: str):
             magic, lrec = struct.unpack("<II", head)
             if magic != _MAGIC:
                 raise MXNetError("malformed recordio file %s" % uri)
-            starts.append(pos)
             # upper 3 bits of the length word are the continue flag
             # (dmlc recordio framing) — mask exactly like read()
             length = lrec & ((1 << 29) - 1)
+            # a payload running past EOF is a torn tail (writer died
+            # mid-record), not a record — same bound as the C scanner
+            if pos + 8 + length > fsize:
+                break
+            starts.append(pos)
             pad = (4 - length % 4) % 4
             f.seek(length + pad, os.SEEK_CUR)
     return starts

@@ -29,18 +29,31 @@ long long tp_recordio_scan(const char* path, long long* offsets,
                            long long* lengths, long long cap) {
   FILE* f = std::fopen(path, "rb");
   if (f == nullptr) return -1;
+  if (std::fseek(f, 0, SEEK_END) != 0) {
+    std::fclose(f);
+    return -1;
+  }
+  const long long fsize = std::ftell(f);
+  std::rewind(f);
   long long n = 0;
   uint32_t head[2];
   for (;;) {
     size_t got = std::fread(head, sizeof(uint32_t), 2, f);
-    if (got == 0) break;  // clean EOF
-    if (got != 2 || head[0] != kMagic) {
+    // A short trailing header (writer died mid-header) is treated as
+    // EOF, matching the Python scanner's walk — only a bad magic on a
+    // *complete* header is malformed framing.
+    if (got != 2) break;
+    if (head[0] != kMagic) {
       std::fclose(f);
       return -1;
     }
     // upper 3 bits of the length word are the continue flag
     long long len = static_cast<long long>(head[1] & ((1u << 29) - 1));
     long long pos = std::ftell(f);
+    // A payload that runs past EOF (writer died mid-record) is a torn
+    // tail, not a record: fseek past EOF succeeds on regular files, so
+    // bound against the real size instead of trusting the header.
+    if (pos + len > fsize) break;
     if (n < cap) {
       offsets[n] = pos;
       lengths[n] = len;

@@ -98,3 +98,27 @@ def test_assemble_batch_u8_and_f32():
                                                     np.float64))
     assert not native.assemble_batch(
         [i.astype(np.float32) for i in imgs], out8)
+
+
+@pytest.mark.parametrize("force_python", [False, True])
+def test_torn_tail_is_eof_not_error(tmp_path, monkeypatch, force_python):
+    """A writer that dies mid-record (torn header OR torn payload) leaves
+    a tail both scanners must treat as EOF — identically, so a file never
+    succeeds or raises depending on whether g++ is available."""
+    if force_python:
+        monkeypatch.setattr(native, "recordio_scan", lambda path: None)
+    elif not HAVE_GXX:
+        pytest.skip("no C++ toolchain")
+    path = str(tmp_path / "full.rec")
+    _write_rec(path, [b"payload-%d" % i * 10 for i in range(5)])
+    starts = recordio.scan_record_starts(path)
+    assert len(starts) == 5
+    data = open(path, "rb").read()
+
+    torn_payload = str(tmp_path / "torn1.rec")
+    open(torn_payload, "wb").write(data[:starts[-1] + 8 + 3])
+    assert recordio.scan_record_starts(torn_payload) == starts[:4]
+
+    torn_header = str(tmp_path / "torn2.rec")
+    open(torn_header, "wb").write(data[:starts[-1] + 3])
+    assert recordio.scan_record_starts(torn_header) == starts[:4]

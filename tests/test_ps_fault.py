@@ -181,3 +181,42 @@ def test_replacement_server_bootstraps_config(cluster):
     # w <- w - lr * grad = 0 - 0.5 * 1 = -0.5
     c.push("w", np.ones(4, np.float32))
     np.testing.assert_allclose(c.pull("w", w), -0.5, rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_late_stale_reseed_does_not_roll_back(cluster):
+    """Two workers recover at different times: the late worker's stale
+    re-seed must not roll back updates applied after the first re-seed,
+    and a legitimate re-init after recovery must apply normally."""
+    c2 = _Cluster(num_servers=1, num_workers=2)
+    try:
+        c0 = ps.PSClient(0, scheduler=("127.0.0.1", c2.port),
+                         recover_servers=True)
+        c1 = ps.PSClient(1, scheduler=("127.0.0.1", c2.port),
+                         recover_servers=True)
+        w = np.zeros(4, np.float32)
+        c0.init("w", np.full(4, 1.0, np.float32))
+        c1.init("w", np.full(4, 1.0, np.float32))
+        c0.push("w", np.full(4, 2.0, np.float32))
+        c1.pull("w", w)  # c1's local re-seed copy caches 2.0
+        c0.push("w", np.full(4, 5.0, np.float32))
+        c0.pull("w", w)  # c0 caches 5.0; c1 stays stale at 2.0
+
+        c2.kill_server(0)
+        c2.respawn_server(0)
+
+        # c0 trips first: re-seeds 5.0, applies its push
+        c0.push("w", np.full(4, 6.0, np.float32))
+        np.testing.assert_array_equal(c0.pull("w", w), 6.0)
+        # c1 trips later: its stale 2.0 re-seed must be ignored
+        c1.push("w", np.full(4, 7.0, np.float32))
+        np.testing.assert_array_equal(c1.pull("w", w), 7.0)
+        np.testing.assert_array_equal(c0.pull("w", w), 7.0)
+
+        # a legitimate (untagged) re-init still applies on the
+        # replacement, identically to a healthy server
+        c0.init("w", np.full(4, 9.0, np.float32))
+        np.testing.assert_array_equal(c0.pull("w", w), 9.0)
+        c0.finalize()
+    finally:
+        c2.shutdown()
