@@ -102,6 +102,18 @@ def main():
         # config provenance: these knobs change what is measured
         "stem": stem, "batch": batch, "layout": layout,
     }
+    if not small:
+        # FLOPs-based utilization (verdict r3 #1): ResNet-50 fwd+bwd ≈
+        # 3 × 4.1 GFLOP/img (fwd conv+fc MACs ×2); vs the chip's
+        # measured sustained matmul rate and nominal peak.  This model
+        # is HBM-bound (PERF.md §8/§10) — the LM flagship is the
+        # MFU-demonstrating config (PERF.md §11, tools/bench_lm.py).
+        sustained = float(os.environ.get("TP_SUSTAINED_TFLOPS", "154"))
+        peak = float(os.environ.get("TP_PEAK_TFLOPS", "197"))
+        tflops = img_s * 3 * 4.1e9 / 1e12
+        record["model_tflops_per_sec"] = round(tflops, 1)
+        record["mfu_vs_sustained"] = round(tflops / sustained, 3)
+        record["mfu_vs_peak"] = round(tflops / peak, 3)
     if flat_opt:
         record["flat_optimizer"] = True
     print(json.dumps(record))

@@ -1,14 +1,54 @@
 """Symbol → pure-jax-function lowering, shared by the Executor and the
 fused parallel train step (single source of truth for op apply / aux
-write-back / RNG-key folding semantics)."""
+write-back / RNG-key folding semantics), including the recompute
+(remat) policy — the reference's ``MXNET_BACKWARD_DO_MIRROR``
+(``src/executor/graph_executor.cc:215-273``) redesigned over
+``jax.checkpoint``."""
 from __future__ import annotations
 
+from .base import get_env
 from .ops.registry import OpContext
 
-__all__ = ["lower_symbol", "lower_symbol_grouped"]
+__all__ = ["lower_symbol", "lower_symbol_grouped", "resolve_remat"]
 
 
-def lower_symbol(symbol, is_train: bool):
+# Ops whose outputs stay resident under the mirror policy: the matmul /
+# conv / recurrence results that are expensive to recompute.  Everything
+# else (activations, norms, reshapes, elementwise chains) is dropped and
+# replayed in backward — the same cheap-op set the reference's mirror
+# heuristic targeted (graph_executor.cc:215-273 mirrors Activation/BN/
+# Pooling-style nodes; env_var.md:89-94).
+_MIRROR_SAVED_OPS = frozenset({
+    "Convolution", "Deconvolution", "FullyConnected", "RNN",
+    "_contrib_DotProductAttention", "dot", "batch_dot", "Embedding",
+    "_contrib_SoftmaxXentHead",
+})
+_MIRROR_NAME = "tp_mirror_saved"
+
+
+def resolve_remat(remat):
+    """Normalize a remat spec: None defers to the env contract —
+    ``TP_BACKWARD_DO_MIRROR`` / ``MXNET_BACKWARD_DO_MIRROR`` (=1 →
+    ``'mirror'``, reference env_var.md:89-94) or ``TP_REMAT_SEGMENTS=K``
+    (uniform K-segment checkpointing).  Returns ``None``, ``'mirror'``,
+    or an int ≥ 1."""
+    if remat is not None:
+        if remat == "mirror":
+            return "mirror"
+        # bools are ints in python; remat=True is almost certainly a
+        # confusion with the boolean mirror env var — refuse it
+        if isinstance(remat, int) and not isinstance(remat, bool) \
+                and remat >= 0:
+            return remat if remat != 0 else None
+        raise ValueError("remat must be None, 'mirror', or an int >= 0 "
+                         "(0 = off), got %r" % (remat,))
+    if get_env("BACKWARD_DO_MIRROR", False, bool):
+        return "mirror"
+    segs = get_env("REMAT_SEGMENTS", 0, int)
+    return segs if segs > 0 else None
+
+
+def lower_symbol(symbol, is_train: bool, remat=None):
     """Lower a Symbol DAG to ``fn(arg_vals, aux_vals, key) ->
     (outputs, new_aux)``.
 
@@ -17,34 +57,141 @@ def lower_symbol(symbol, is_train: bool):
     PRNG keys derived by ``fold_in`` and functional aux-state threading
     (the reference mutated aux NDArrays in place; here the executor
     rebinds them).
+
+    ``remat`` (training only) trades recompute FLOPs for activation
+    memory, the ``MXNET_BACKWARD_DO_MIRROR`` capability redesigned for
+    XLA: ``'mirror'`` wraps the graph in one ``jax.checkpoint`` whose
+    policy saves only matmul/conv-family outputs (cheap ops replay in
+    backward); an int K splits the topo order into K contiguous
+    segments, each checkpointed, so only segment-boundary activations
+    survive the forward pass (per-device memory ~ boundaries + one
+    segment's internals — the layerwise scheme for deep stacks).
     """
     import jax
 
     nodes = symbol.topo_nodes()
     outputs = symbol._outputs
     aux_names = set(symbol.list_auxiliary_states())
+    remat = resolve_remat(remat) if is_train else None
+
+    mirror = remat == "mirror"
 
     def fn(arg_vals, aux_vals, key):
-        env = {}
-        new_aux = dict(aux_vals)
-        for ni, node in enumerate(nodes):
-            if node.is_variable:
-                env[(id(node), 0)] = (new_aux[node.name]
-                                      if node.name in aux_names
-                                      else arg_vals[node.name])
-                continue
-            ins = [env[(id(inp), idx)] for inp, idx in node.inputs]
-            rng = jax.random.fold_in(key, ni) if node.op.needs_rng else None
-            outs, naux = node.op.apply(
-                ins, node.attrs, OpContext(is_train=is_train, rng=rng))
-            for i, o in enumerate(outs):
-                env[(id(node), i)] = o
-            if node.op.has_aux:
-                n_args = len(node.op.get_arg_names(node.attrs))
-                for (inp, _), val in zip(node.inputs[n_args:], naux):
-                    if inp.is_variable:
-                        new_aux[inp.name] = val
+        env, new_aux = _interpret(
+            enumerate(nodes), {}, arg_vals, aux_vals, key,
+            is_train=is_train, aux_names=aux_names, mirror=mirror)
         return [env[(id(n), i)] for n, i in outputs], new_aux
+
+    if remat is None:
+        return fn
+    if mirror:
+        policy = jax.checkpoint_policies.save_only_these_names(
+            _MIRROR_NAME)
+        return jax.checkpoint(fn, policy=policy)
+    return _lower_segmented(nodes, outputs, aux_names, int(remat))
+
+
+def _interpret(node_list, env, arg_vals, aux_vals, key, *, is_train,
+               aux_names, mirror=False):
+    """THE interpretation loop (single source of truth for op apply /
+    RNG fold-in / aux write-back): run ``(ni, node)`` pairs over a
+    pre-seeded ``env``, returning ``(env, new_aux)``.  ``mirror`` tags
+    matmul/conv-family outputs for the checkpoint save policy."""
+    import jax
+
+    if mirror:
+        from jax.ad_checkpoint import checkpoint_name
+    new_aux = dict(aux_vals)
+    for ni, node in node_list:
+        if node.is_variable:
+            env[(id(node), 0)] = (new_aux[node.name]
+                                  if node.name in aux_names
+                                  else arg_vals[node.name])
+            continue
+        ins = [env[(id(inp), idx)] for inp, idx in node.inputs]
+        rng = jax.random.fold_in(key, ni) if node.op.needs_rng else None
+        outs, naux = node.op.apply(
+            ins, node.attrs, OpContext(is_train=is_train, rng=rng))
+        if mirror and node.op.name in _MIRROR_SAVED_OPS:
+            outs = [checkpoint_name(o, _MIRROR_NAME) for o in outs]
+        for i, o in enumerate(outs):
+            env[(id(node), i)] = o
+        if node.op.has_aux:
+            n_args = len(node.op.get_arg_names(node.attrs))
+            for (inp, _), val in zip(node.inputs[n_args:], naux):
+                if inp.is_variable:
+                    new_aux[inp.name] = val
+    return env, new_aux
+
+
+def _lower_segmented(nodes, outputs, aux_names, nseg):
+    """K-segment checkpointed lowering: contiguous topo chunks, each
+    under ``jax.checkpoint`` so only boundary values are saved."""
+    import jax
+
+    compute = [(ni, n) for ni, n in enumerate(nodes) if not n.is_variable]
+    nseg = max(1, min(nseg, len(compute)))
+    per = -(-len(compute) // nseg)  # ceil
+    chunks = [compute[i:i + per] for i in range(0, len(compute), per)]
+
+    var_by_id = {id(n): n for n in nodes if n.is_variable}
+    out_entries = [(id(n), i) for n, i in outputs]
+
+    segs = []
+    for chunk in chunks:
+        ids = {id(n) for _, n in chunk}
+        ext, seen = [], set()
+        for _, node in chunk:
+            for inp, idx in node.inputs:
+                k = (id(inp), idx)
+                if id(inp) not in ids and k not in seen:
+                    seen.add(k)
+                    ext.append(k)
+        segs.append({"nodes": chunk, "ids": ids, "ext_keys": ext})
+    cross = set(out_entries)
+    for seg in segs:
+        cross.update(seg["ext_keys"])
+    for seg in segs:
+        seg["out_keys"] = sorted(k for k in cross if k[0] in seg["ids"])
+
+    def make_seg_fn(seg):
+        seg_nodes = seg["nodes"]
+        ext_keys = tuple(seg["ext_keys"])
+        out_keys = tuple(seg["out_keys"])
+
+        def seg_fn(ext_vals, aux_vals, key):
+            # boundary values pre-seed env; chunks hold no variable
+            # nodes (those resolve at the driver), so arg_vals is empty
+            env, new_aux = _interpret(
+                seg_nodes, dict(zip(ext_keys, ext_vals)), {}, aux_vals,
+                key, is_train=True, aux_names=aux_names)
+            upd = {k: v for k, v in new_aux.items()
+                   if v is not aux_vals.get(k)}
+            return [env[k] for k in out_keys], upd
+
+        return jax.checkpoint(seg_fn)
+
+    for seg in segs:
+        seg["fn"] = make_seg_fn(seg)
+
+    def fn(arg_vals, aux_vals, key):
+        new_aux = dict(aux_vals)
+        env = {}
+
+        def resolve(k):
+            var = var_by_id.get(k[0])
+            if var is not None:
+                return (aux_vals[var.name] if var.name in aux_names
+                        else arg_vals[var.name])
+            return env[k]
+
+        for seg in segs:
+            ext_vals = [resolve(k) for k in seg["ext_keys"]]
+            out_vals, upd = seg["fn"](ext_vals, aux_vals, key)
+            for k, v in zip(seg["out_keys"], out_vals):
+                env[k] = v
+            new_aux.update(upd)
+        return [resolve(k) for k in out_entries], new_aux
 
     return fn
 

@@ -55,10 +55,16 @@ def _block(x, batch, seq, embed, heads, name, causal=True,
 
 def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
                seq_len=64, batch_size=8, causal=True, dtype="float32",
-               attn_impl="auto", **kwargs):
+               attn_impl="auto", head="softmax", **kwargs):
     """Decoder-only LM.  Inputs ``data`` (B, S) int tokens and
-    ``softmax_label`` (B·S,) next-token targets; outputs per-position
-    softmax over the vocabulary.
+    ``softmax_label`` (B·S,) next-token targets.
+
+    ``head='softmax'`` outputs per-position softmax over the vocabulary
+    (``SoftmaxOutput`` semantics — O(B·S·V) output, fine for small V);
+    ``head='fused'`` outputs the (B·S,) per-position cross-entropy loss
+    through the chunked ``_contrib_SoftmaxXentHead``, which never
+    materializes the (B·S, V) logits — the memory-safe configuration
+    for large-vocab training (PERF.md §8c OOM analysis).
 
     Shapes are static (XLA contract) — batch/seq are build parameters,
     mirroring how ``BucketingModule`` handled variable length in the
@@ -91,11 +97,15 @@ def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
     x = sym.LayerNorm(x, axis=-1, name="ln_f")
     x = sym.Reshape(x, shape=(batch_size * seq_len, embed),
                     name="flatten_positions")
-    logits = sym.FullyConnected(x, num_hidden=vocab_size, name="lm_head")
-    if dtype in ("float16", "bfloat16"):
-        logits = sym.Cast(logits, dtype="float32", name="logits_f32")
     # label comes in (B, S) like the PTB LSTM family and flattens to the
     # positions axis inside the graph (lstm_ptb.py:45 convention), so
     # Module's batch-axis slicing stays valid
     label_flat = sym.Reshape(label, shape=(-1,), name="label_flat")
+    if head == "fused":
+        w = sym.Variable("lm_head_weight")
+        return sym.SoftmaxXentHead(x, w, label_flat,
+                                   num_hidden=vocab_size, name="softmax")
+    logits = sym.FullyConnected(x, num_hidden=vocab_size, name="lm_head")
+    if dtype in ("float16", "bfloat16"):
+        logits = sym.Cast(logits, dtype="float32", name="logits_f32")
     return sym.SoftmaxOutput(logits, label_flat, name="softmax")

@@ -421,6 +421,172 @@ def _softmax_output(ins, attrs, ctx):
     return fn(ins[0], ins[1])
 
 
+# ---------------------------------------------------------------------------
+# Fused chunked softmax-cross-entropy head
+# ---------------------------------------------------------------------------
+
+def _sxh_pick_chunk(n, vocab, requested):
+    """Largest divisor of ``n`` whose (chunk, vocab) logits block stays
+    near 64M elements — big enough to keep the MXU busy and the (V, E)
+    dW accumulator traffic amortized, small enough that the block never
+    dominates HBM."""
+    if requested > 0:
+        target = min(requested, n)
+    else:
+        target = max(128, min(n, (1 << 26) // max(vocab, 1)))
+    for c in range(min(target, n), 0, -1):
+        if n % c == 0:
+            return c
+    return n
+
+
+@functools.lru_cache(maxsize=None)
+def _softmax_xent_head_fn(grad_scale, ignore_label, use_ignore,
+                          normalization, chunk):
+    """Build the fused projection+softmax+cross-entropy head.
+
+    The LM-head answer to ``SoftmaxOutput``'s O(N·V) materialization
+    (reference semantics ``src/operator/softmax_output-inl.h:48``): the
+    (N, V) logits/probabilities never exist at once.  Forward scans row
+    chunks computing an online logsumexp + target-logit gather; backward
+    is a second scan recomputing each chunk's logits (flash-style
+    rematerialization) and emitting dX chunks while accumulating dW in
+    f32.  Matmuls run in the activation dtype (bf16 on TPU) with f32
+    accumulation via ``preferred_element_type``.
+
+    Same loss-head convention as ``SoftmaxOutput``: backward ignores the
+    incoming cotangent and emits the cross-entropy gradient scaled by
+    ``grad_scale`` (normalization: null | batch | valid).
+    """
+
+    def _stats(xc, w, lab_c):
+        # one chunk: logits in act dtype with f32 accumulation
+        logits = jnp.matmul(xc, w.astype(xc.dtype).T,
+                            preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, lab_c[:, None], axis=-1)[:, 0]
+        return lse, tgt
+
+    def _fwd_loss(x, w, label):
+        n = x.shape[0]
+        c = _sxh_pick_chunk(n, w.shape[0], chunk)
+        lab = jnp.clip(label.reshape(-1).astype(jnp.int32), 0,
+                       w.shape[0] - 1)
+        if c == n:
+            lse, tgt = _stats(x, w, lab)
+        else:
+            xs = x.reshape(n // c, c, x.shape[1])
+            labs = lab.reshape(n // c, c)
+            _, (lse, tgt) = jax.lax.scan(
+                lambda _, xl: (None, _stats(xl[0], w, xl[1])),
+                None, (xs, labs))
+            lse, tgt = lse.reshape(n), tgt.reshape(n)
+        loss = lse - tgt
+        if use_ignore:
+            valid = (label.reshape(-1).astype(jnp.int32)
+                     != int(ignore_label))
+            loss = jnp.where(valid, loss, 0.0)
+        return loss, lse
+
+    @jax.custom_vjp
+    def f(x, w, label):
+        return _fwd_loss(x, w, label)[0]
+
+    def f_fwd(x, w, label):
+        loss, lse = _fwd_loss(x, w, label)
+        return loss, (x, w, label, lse)
+
+    def f_bwd(res, g):
+        x, w, label, lse = res
+        n, e = x.shape
+        v = w.shape[0]
+        c = _sxh_pick_chunk(n, v, chunk)
+        lab_raw = label.reshape(-1).astype(jnp.int32)
+        lab = jnp.clip(lab_raw, 0, v - 1)
+
+        scale = jnp.float32(grad_scale)
+        if use_ignore:
+            valid = (lab_raw != int(ignore_label))
+            if normalization == "valid":
+                scale = scale / jnp.maximum(
+                    valid.sum().astype(jnp.float32), 1.0)
+        else:
+            valid = None
+        if normalization == "batch":
+            scale = scale / n
+        wc = w.astype(x.dtype)
+
+        def chunk_grads(xc, lab_c, lse_c, valid_c):
+            logits = jnp.matmul(xc, wc.T,
+                                preferred_element_type=jnp.float32)
+            d = jnp.exp(logits - lse_c[:, None])
+            d = d - jax.nn.one_hot(lab_c, v, dtype=d.dtype)
+            if valid_c is not None:
+                d = d * valid_c[:, None].astype(d.dtype)
+            d = (d * scale).astype(x.dtype)
+            dx_c = jnp.matmul(d, wc)
+            dw_c = jnp.matmul(d.T, xc,
+                              preferred_element_type=jnp.float32)
+            return dx_c, dw_c
+
+        if c == n:
+            dx, dw = chunk_grads(x, lab, lse, valid)
+        else:
+            xs = x.reshape(n // c, c, e)
+            labs = lab.reshape(n // c, c)
+            lses = lse.reshape(n // c, c)
+            valids = valid.reshape(n // c, c) if valid is not None \
+                else jnp.zeros((n // c, 0))
+
+            def body(dw_acc, xl):
+                xc, lab_c, lse_c, valid_c = xl
+                dx_c, dw_c = chunk_grads(
+                    xc, lab_c, lse_c,
+                    valid_c if use_ignore else None)
+                return dw_acc + dw_c, dx_c
+
+            dw, dxs = jax.lax.scan(
+                body, jnp.zeros((v, e), jnp.float32),
+                (xs, labs, lses, valids))
+            dx = dxs.reshape(n, e)
+        return dx, dw.astype(w.dtype), jnp.zeros_like(label)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def _sxh_infer_shape(in_shapes, attrs):
+    vocab = parse_int(attrs.get("num_hidden"))
+    data_s = in_shapes[0]
+    if data_s is None:
+        return in_shapes, [None], []
+    w = (vocab, data_s[-1])
+    lab = (data_s[0],)
+    return [data_s, in_shapes[1] or w, in_shapes[2] or lab], \
+        [(data_s[0],)], []
+
+
+@register("_contrib_SoftmaxXentHead",
+          arg_names=["data", "weight", "label"],
+          aliases=["SoftmaxXentHead"], infer_shape=_sxh_infer_shape)
+def _softmax_xent_head(ins, attrs, ctx):
+    """Fused LM head: ``loss[i] = logsumexp(x[i]·Wᵀ) - (x[i]·Wᵀ)[y[i]]``
+    over row chunks — O(chunk·V) live memory instead of O(N·V).
+
+    ``data`` (N, E), ``weight`` (num_hidden, E) [the vocab projection],
+    ``label`` (N,); output (N,) f32 per-position loss.  Attrs:
+    ``num_hidden`` (vocab), ``grad_scale``, ``use_ignore``/
+    ``ignore_label``, ``normalization`` (null|batch|valid), ``chunk``
+    (row-chunk override, 0 = auto)."""
+    fn = _softmax_xent_head_fn(
+        parse_float(attrs.get("grad_scale", 1.0)),
+        parse_float(attrs.get("ignore_label", -1.0)),
+        parse_bool(attrs.get("use_ignore", False)),
+        attrs.get("normalization", "null"),
+        parse_int(attrs.get("chunk", 0)))
+    return fn(ins[0], ins[1], ins[2])
+
+
 def _regression_output(name, fwd, bwd):
     @functools.lru_cache(maxsize=None)
     def build(grad_scale):
@@ -589,15 +755,22 @@ def _ln_infer_shape(in_shapes, attrs):
 @register("LayerNorm", arg_names=["data", "gamma", "beta"],
           infer_shape=_ln_infer_shape)
 def _layer_norm(ins, attrs, ctx):
+    """Mixed precision: statistics and affine in f32, output cast back
+    to the input dtype — f32 gamma/beta must NOT promote a bf16
+    activation stream (a promoted output turns every downstream matmul
+    into an f32 MXU op; caught in the round-4 LM xplane trace)."""
     data, gamma, beta = ins
     eps = parse_float(attrs.get("eps", 1e-5))
     axis = parse_int(attrs.get("axis"), -1)
-    mean = jnp.mean(data, axis=axis, keepdims=True)
-    var = jnp.var(data, axis=axis, keepdims=True)
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
     shp = [1] * data.ndim
     shp[axis] = data.shape[axis]
-    return (data - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(shp) \
-        + beta.reshape(shp)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps) \
+        * gamma.astype(jnp.float32).reshape(shp) \
+        + beta.astype(jnp.float32).reshape(shp)
+    return y.astype(data.dtype)
 
 
 @register("LRN", arg_names=["data"])

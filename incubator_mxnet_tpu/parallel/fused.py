@@ -53,11 +53,16 @@ class FusedTrainStep:
                  optimizer_params: Optional[Dict[str, Any]] = None,
                  initializer=None, dtype=None, seed: int = 0,
                  param_partition: Optional[Dict[str, Any]] = None,
-                 flat_optimizer: bool = False):
+                 flat_optimizer: bool = False, remat=None):
         import jax
         import jax.numpy as jnp
 
         self.symbol = symbol
+        # recompute policy (MXNET_BACKWARD_DO_MIRROR parity): None reads
+        # the TP_BACKWARD_DO_MIRROR / TP_REMAT_SEGMENTS env contract,
+        # 'mirror' saves only matmul/conv outputs, int K checkpoints K
+        # uniform graph segments (lowering.resolve_remat)
+        self.remat = remat
         self.mesh = mesh if mesh is not None else default_mesh()
         label_shapes = label_shapes or {}
         shapes = dict(data_shapes)
@@ -148,7 +153,7 @@ class FusedTrainStep:
         import jax
         import jax.numpy as jnp
 
-        fwd = _lower_symbol(self.symbol, is_train=True)
+        fwd = _lower_symbol(self.symbol, is_train=True, remat=self.remat)
         opt_op = get_op(self._opt_op)
         opt_attrs = dict(self._opt_attrs)
         n_states = self._n_states

@@ -2,22 +2,37 @@
 
 The reference's only model parallelism is manual layer placement via
 ``group2ctx`` + ``_CrossDeviceCopy`` (``graph_executor.cc:279-393``),
-demonstrated by the model-parallel LSTM example.  The TPU-native
+demonstrated by the model-parallel LSTM example
+(``example/model-parallel-lstm/lstm.py:65-68``).  The TPU-native
 generalization is a collective-permute pipeline: device *i* holds stage
 *i*'s parameters, microbatches flow device→device over ICI via
 ``lax.ppermute`` inside one jitted program (GPipe schedule: M + L − 1
 ticks for M microbatches through L stages), so stage compute and the
 activation hop overlap the way ``_CrossDeviceCopy`` engine ops did.
 
-All stages must share one activation shape (the classic constraint);
-width changes belong inside a stage.
+Two layers:
+
+- ``pipeline_apply`` / ``pipeline_parallel_apply``: the generic
+  forward utility (uniform stage_fn, replicated microbatches) — kept
+  for toy stage functions and the multi-axis dryrun.
+- ``PipelineTrainStep``: REAL pipelined training of the transformer-LM
+  family — full fwd+bwd+optimizer in one jitted SPMD program.
+  Microbatch TOKENS (not activations) are injected, the loss is taken
+  from the last stage only (scalar psum — no L× activation broadcast),
+  every stage tick is ``jax.checkpoint``-ed so in-flight residuals stay
+  at one boundary activation per tick (the memory property 1F1B
+  targets, obtained here by recompute under the GPipe order), and
+  gradients accumulate over microbatches inside the program.
 """
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Any, Callable, Dict, Optional
 
-__all__ = ["pipeline_apply", "pipeline_parallel_apply"]
+import numpy as np
+
+__all__ = ["pipeline_apply", "pipeline_parallel_apply",
+           "PipelineTrainStep"]
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
@@ -113,3 +128,375 @@ def _build_pipeline(mesh, stage_fn, axis_name, params_treedef):
     fn = shard_map_fn()(body, mesh=mesh,
                         in_specs=(spec_p, P()), out_specs=P())
     return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# PipelineTrainStep: real pipelined training for the transformer-LM family
+# ---------------------------------------------------------------------------
+
+def _pp_layer_norm(x, g, b):
+    # the REGISTERED LayerNorm op (ops/nn.py) — single source of truth
+    # for norm semantics (f32 stats, cast back to activation dtype)
+    from ..ops.registry import OpContext, get_op
+
+    (y,), _ = get_op("LayerNorm").apply(
+        [x, g, b], {"axis": "-1"}, OpContext(is_train=True))
+    return y
+
+
+def _pp_fc(x, w, b=None):
+    # the REGISTERED FullyConnected op (ops/nn.py) — single source of
+    # truth for the y = x·Wᵀ (+bias) dtype/cast rules
+    from ..ops.registry import OpContext, get_op
+
+    attrs = {"num_hidden": str(w.shape[0]), "flatten": "False",
+             "no_bias": str(b is None)}
+    ins = [x, w] if b is None else [x, w, b]
+    (y,), _ = get_op("FullyConnected").apply(
+        ins, attrs, OpContext(is_train=True))
+    return y
+
+
+def _pp_block(x, p, heads, causal, attn_impl):
+    """One pre-norm transformer block, matching models/transformer.py
+    (same ops, same order) so pipelined training is numerically the
+    symbol model's training."""
+    import jax.numpy as jnp
+
+    from .sequence import attention
+
+    bsz, seq, embed = x.shape
+    d = embed // heads
+    ln1 = _pp_layer_norm(x, p["ln1_gamma"], p["ln1_beta"])
+
+    def split(t):
+        return t.reshape(bsz, seq, heads, d).transpose(0, 2, 1, 3)
+
+    q = split(_pp_fc(ln1, p["q_weight"]))
+    k = split(_pp_fc(ln1, p["k_weight"]))
+    v = split(_pp_fc(ln1, p["v_weight"]))
+    att = attention(q, k, v, causal=causal, impl=attn_impl)
+    att = att.transpose(0, 2, 1, 3).reshape(bsz, seq, embed)
+    x = x + _pp_fc(att, p["attn_proj_weight"], p["attn_proj_bias"])
+    ln2 = _pp_layer_norm(x, p["ln2_gamma"], p["ln2_beta"])
+    h = _pp_fc(ln2, p["ffn1_weight"], p["ffn1_bias"])
+    h = jnp.maximum(h, 0)
+    return x + _pp_fc(h, p["ffn2_weight"], p["ffn2_bias"])
+
+
+_PP_BLOCK_LEAVES = (
+    ("ln1_gamma", "E", 1.0), ("ln1_beta", "E", 0.0),
+    ("q_weight", "EE", None), ("k_weight", "EE", None),
+    ("v_weight", "EE", None),
+    ("attn_proj_weight", "EE", None), ("attn_proj_bias", "E", 0.0),
+    ("ln2_gamma", "E", 1.0), ("ln2_beta", "E", 0.0),
+    ("ffn1_weight", "4EE", None), ("ffn1_bias", "4E", 0.0),
+    ("ffn2_weight", "E4E", None), ("ffn2_bias", "E", 0.0),
+)
+
+
+class PipelineTrainStep:
+    """Pipelined transformer-LM training over a ``pp`` mesh axis — the
+    trainer the round-3 forward-only utility was not.
+
+    One jitted SPMD program per step: a GPipe tick loop under shard_map
+    (M microbatches, L = pp-axis stages, M + L − 1 ticks) with
+    - microbatch TOKENS injected at stage 0 (embedding computed in-tick;
+      no replicated activation broadcast),
+    - per-tick ``jax.checkpoint`` (in-flight residual = one boundary
+      activation per tick — the memory property 1F1B schedules target,
+      obtained by recompute under the GPipe order),
+    - the fused chunked softmax-xent head on the LAST stage only
+      (non-final stages feed the head zeros, whose dW is exactly zero,
+      so the replicated head gradient psum stays correct),
+    - gradient accumulation across microbatches inside the program and
+      the same fused optimizer ops as ``FusedTrainStep``.
+
+    Reference parity anchor: ``example/model-parallel-lstm/lstm.py:65-68``
+    (manual per-device layer placement); here the schedule, transfers
+    and grad accumulation are compiler-visible XLA collectives.
+    """
+
+    def __init__(self, mesh, vocab_size, embed, heads, num_layers,
+                 seq_len, batch_size, num_microbatches,
+                 dtype: str = "float32", attn_impl: str = "auto",
+                 causal: bool = True, optimizer: str = "adam",
+                 optimizer_params: Optional[Dict[str, Any]] = None,
+                 initializer=None, axis_name: str = "pp"):
+        import jax
+        import jax.numpy as jnp
+
+        if batch_size % num_microbatches:
+            raise ValueError("batch_size %d must divide into %d "
+                             "microbatches" % (batch_size,
+                                               num_microbatches))
+        self.mesh = mesh
+        npp = mesh.shape[axis_name]
+        if num_layers % npp:
+            raise ValueError("num_layers %d must divide over %d pipeline "
+                             "stages" % (num_layers, npp))
+        self.axis_name = axis_name
+        self.cfg = dict(vocab_size=vocab_size, embed=embed, heads=heads,
+                        num_layers=num_layers, seq_len=seq_len,
+                        batch_size=batch_size,
+                        num_microbatches=num_microbatches, dtype=dtype,
+                        attn_impl=attn_impl, causal=causal)
+
+        # ---- optimizer (FusedTrainStep's resolution, compact) --------
+        opt_params = dict(optimizer_params or {})
+        self.lr = float(opt_params.pop("learning_rate", 0.01))
+        momentum = float(opt_params.get("momentum", 0.0))
+        if optimizer == "sgd":
+            if momentum != 0.0:
+                self._opt_op, self._n_states = "sgd_mom_update", 1
+            else:
+                self._opt_op, self._n_states = "sgd_update", 0
+                opt_params.pop("momentum", None)
+        elif optimizer == "adam":
+            self._opt_op, self._n_states = "adam_update", 2
+        else:
+            raise ValueError("PipelineTrainStep supports sgd/adam, got %s"
+                             % optimizer)
+        opt_params.setdefault("rescale_grad", 1.0 / batch_size)
+        self._opt_attrs = opt_params
+        self._adam_b1 = float(opt_params.get("beta1", 0.9))
+        self._adam_b2 = float(opt_params.get("beta2", 0.999))
+        self.num_update = 0
+
+        # ---- parameters (symbol-compatible names) --------------------
+        from ..initializer import InitDesc, Uniform
+        from ..ndarray import zeros as nd_zeros
+
+        initializer = initializer or Uniform(0.01)
+
+        def host_init(name, shape):
+            arr = nd_zeros(shape)
+            initializer(InitDesc(name), arr)
+            return np.asarray(arr.data)
+
+        E, V, S = embed, vocab_size, seq_len
+        dims = {"E": (E,), "EE": (E, E), "4EE": (4 * E, E),
+                "4E": (4 * E,), "E4E": (E, 4 * E)}
+        blocks = {}
+        for leaf, dim, fill in _PP_BLOCK_LEAVES:
+            per = []
+            for i in range(num_layers):
+                # gamma/beta get their reference-init constants; weights
+                # go through the initializer under their symbol name
+                name = "block%d_%s" % (i, leaf)
+                if fill is not None:
+                    per.append(np.full(dims[dim], fill, np.float32))
+                else:
+                    per.append(host_init(name, dims[dim]))
+            blocks[leaf] = np.stack(per)
+        self._rep = {
+            "tok_embed_weight": host_init("tok_embed_weight", (V, E)),
+            "pos_embed_weight": host_init("pos_embed_weight", (S, E)),
+            "ln_f_gamma": np.ones((E,), np.float32),
+            "ln_f_beta": np.zeros((E,), np.float32),
+            "lm_head_weight": host_init("lm_head_weight", (V, E)),
+        }
+
+        P = jax.sharding.PartitionSpec
+        stack_sh = jax.sharding.NamedSharding(mesh, P(axis_name))
+        rep_sh = jax.sharding.NamedSharding(mesh, P())
+        self.params = {k: jax.device_put(v, stack_sh)
+                       for k, v in blocks.items()}
+        self.params.update({k: jax.device_put(v, rep_sh)
+                            for k, v in self._rep.items()})
+        self._shardings = {k: (stack_sh if k in blocks else rep_sh)
+                           for k in self.params}
+        self.opt_states = {
+            n: tuple(jax.device_put(np.zeros_like(np.asarray(v)),
+                                    self._shardings[n])
+                     for _ in range(self._n_states))
+            for n, v in self.params.items()}
+        self._step_fn = self._build()
+
+    # ------------------------------------------------------------ build
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops.nn import _softmax_xent_head_fn
+        from ..ops.registry import OpContext, get_op
+        from .mesh import shard_map_fn
+
+        cfg = self.cfg
+        axis = self.axis_name
+        M = cfg["num_microbatches"]
+        b = cfg["batch_size"] // M
+        S, E, V = cfg["seq_len"], cfg["embed"], cfg["vocab_size"]
+        heads, causal = cfg["heads"], cfg["causal"]
+        attn_impl = cfg["attn_impl"]
+        lowp = cfg["dtype"] in ("float16", "bfloat16")
+        act_dtype = jnp.dtype(cfg["dtype"]) if lowp else jnp.float32
+        sxh = _softmax_xent_head_fn(1.0, -1.0, False, "null", 0)
+        block_leaves = [l for l, _, _ in _PP_BLOCK_LEAVES]
+
+        def stage_apply(bp, x):
+            # scan over this stage's local blocks
+            def one(x, p):
+                return _pp_block(x, p, heads, causal, attn_impl), None
+
+            x, _ = lax.scan(one, x, bp)
+            return x
+
+        stage_apply = jax.checkpoint(stage_apply)
+
+        def pipeline_loss(params, tokens, labels):
+            # inside shard_map: block leaves are (layers/L, ...) local
+            L = lax.axis_size(axis)
+            idx = lax.axis_index(axis)
+            bp = {l: params[l] for l in block_leaves}
+            tok_w = params["tok_embed_weight"]
+            pos_w = params["pos_embed_weight"]
+
+            def embed(tk):
+                x = tok_w[tk.astype(jnp.int32)] + pos_w[None, :, :]
+                return x.astype(act_dtype)
+
+            state = jnp.zeros((b, S, E), act_dtype)
+            outs = jnp.zeros((M, b, S, E), act_dtype)
+            if hasattr(lax, "pcast"):
+                state = lax.pcast(state, (axis,), to="varying")
+                outs = lax.pcast(outs, (axis,), to="varying")
+            perm = [(i, i + 1) for i in range(L - 1)]
+
+            def tick(carry, t):
+                state, outs = carry
+                x0 = embed(tokens[jnp.minimum(t, M - 1)])
+                x_in = jnp.where(idx == 0, x0, state)
+                y = stage_apply(bp, x_in)
+                slot = t - (L - 1)
+                take = (idx == L - 1) & (slot >= 0) & (slot < M)
+                safe = jnp.clip(slot, 0, M - 1)
+                outs = outs.at[safe].set(jnp.where(take, y, outs[safe]))
+                state = lax.ppermute(y, axis, perm)
+                return (state, outs), None
+
+            (_, outs), _ = lax.scan(tick, (state, outs),
+                                    jnp.arange(M + L - 1))
+            # head on the LAST stage only: other stages feed zeros, so
+            # their (cotangent-ignoring) fused-head dW is exactly zero
+            # and the replicated head-grad psum stays correct
+            z = _pp_layer_norm(outs.reshape(M * b * S, E),
+                               params["ln_f_gamma"],
+                               params["ln_f_beta"])
+            z = jnp.where(idx == L - 1, z, jnp.zeros_like(z))
+            loss_vec = sxh(z, params["lm_head_weight"],
+                           labels.reshape(-1).astype(jnp.float32))
+            loss = jnp.sum(jnp.where(idx == L - 1, loss_vec, 0.0))
+            return lax.psum(loss, axis)
+
+        P = jax.sharding.PartitionSpec
+        spec_of = {n: (P(axis) if n in block_leaves else P())
+                   for n in self.params}
+        shard_map = shard_map_fn()
+        smap_kw = dict(mesh=self.mesh,
+                       in_specs=({n: spec_of[n] for n in self.params},
+                                 P(), P()),
+                       out_specs=P())
+        # replication of the replicated-param cotangents cannot be
+        # statically inferred through the transpose of the tick loop —
+        # disable the varying-axes check (the transpose then inserts
+        # the psums itself); flag name differs across jax versions
+        try:
+            sharded_loss = shard_map(pipeline_loss, check_vma=False,
+                                     **smap_kw)
+        except TypeError:  # pragma: no cover - older jax
+            sharded_loss = shard_map(pipeline_loss, check_rep=False,
+                                     **smap_kw)
+
+        opt_op = get_op(self._opt_op)
+        opt_attrs = dict(self._opt_attrs)
+        n_states = self._n_states
+        is_adam = self._opt_op == "adam_update"
+        b1, b2 = self._adam_b1, self._adam_b2
+
+        def step(params, opt_states, lr, t, tokens, labels):
+            if is_adam:
+                lr = lr * jnp.sqrt(1.0 - jnp.power(b2, t)) \
+                    / (1.0 - jnp.power(b1, t))
+            loss, grads = jax.value_and_grad(sharded_loss)(
+                params, tokens, labels)
+            new_params, new_states = {}, {}
+            for name, w in params.items():
+                g = grads[name].astype(w.dtype)
+                res, _ = opt_op.apply(
+                    [w, g] + list(opt_states[name]),
+                    dict(opt_attrs, lr=lr), OpContext(is_train=True))
+                new_params[name] = res[0]
+                new_states[name] = tuple(res[1:1 + n_states])
+            return new_params, new_states, loss
+
+        param_sh = self._shardings
+        state_sh = {n: tuple(param_sh[n] for _ in range(n_states))
+                    for n in self.params}
+        rep = jax.sharding.NamedSharding(self.mesh, P())
+        return jax.jit(step,
+                       in_shardings=(param_sh, state_sh, None, None,
+                                     rep, rep),
+                       out_shardings=(param_sh, state_sh, None),
+                       donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------- call
+    def __call__(self, batch: Dict[str, Any]):
+        """One pipelined train step; returns the mean per-position loss."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        M = cfg["num_microbatches"]
+        b = cfg["batch_size"] // M
+        self.num_update += 1
+        tokens = jnp.asarray(np.asarray(batch["data"])).reshape(
+            M, b, cfg["seq_len"])
+        labels = jnp.asarray(np.asarray(batch["softmax_label"])).reshape(
+            M, b, cfg["seq_len"])
+        self.params, self.opt_states, loss = self._step_fn(
+            self.params, self.opt_states, jnp.float32(self.lr),
+            jnp.float32(self.num_update), tokens, labels)
+        n = cfg["batch_size"] * cfg["seq_len"]
+        return float(loss) / n
+
+    # ------------------------------------------------------------ fence
+    def sync(self) -> float:
+        name = min(self.params, key=lambda n: self.params[n].size)
+        return float(np.asarray(self.params[name]).ravel()[0])
+
+    # ----------------------------------------------------------- params
+    def get_params(self):
+        """Per-block symbol-style names (block{i}_*, tok_embed_weight,
+        …) → NDArray, Module/checkpoint-compatible."""
+        from ..ndarray.ndarray import NDArray
+
+        out = {}
+        for leaf, _, _ in _PP_BLOCK_LEAVES:
+            stacked = np.asarray(self.params[leaf])
+            for i in range(self.cfg["num_layers"]):
+                out["block%d_%s" % (i, leaf)] = NDArray(stacked[i])
+        for n in self._rep:
+            out[n] = NDArray(np.asarray(self.params[n]))
+        return out
+
+    def set_params(self, arg_params):
+        """Load per-block named params (the inverse of get_params)."""
+        import jax
+
+        def data(v):
+            return np.asarray(v.data if hasattr(v, "data") else v)
+
+        for leaf, _, _ in _PP_BLOCK_LEAVES:
+            per = []
+            for i in range(self.cfg["num_layers"]):
+                name = "block%d_%s" % (i, leaf)
+                per.append(data(arg_params[name]) if name in arg_params
+                           else np.asarray(self.params[leaf])[i])
+            self.params[leaf] = jax.device_put(
+                np.stack(per), self._shardings[leaf])
+        for n in self._rep:
+            if n in arg_params:
+                self.params[n] = jax.device_put(
+                    data(arg_params[n]), self._shardings[n])

@@ -23,8 +23,22 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
-__all__ = ["attention", "ring_attention", "ulysses_attention",
-           "sequence_parallel_attention"]
+__all__ = ["attention", "flash_eligible", "ring_attention",
+           "ulysses_attention", "sequence_parallel_attention"]
+
+
+def flash_eligible(q_shape, k_shape) -> bool:
+    """True when ``attention(impl='auto')`` would take the Pallas flash
+    path for these shapes (TPU backend, 4-D, lane-aligned head_dim and
+    seq lens).  THE gate — shared with ``tools/bench_lm.py``'s
+    executed-FLOPs accounting so the causal halving can never drift
+    from the kernel actually run."""
+    import jax
+
+    # 'axon' is this session's TPU-via-tunnel platform name
+    return (jax.default_backend() in ("tpu", "axon")
+            and len(q_shape) == 4 and q_shape[-1] % 128 == 0
+            and q_shape[-2] % 128 == 0 and k_shape[-2] % 128 == 0)
 
 
 def _neg_inf(dtype):
@@ -73,17 +87,27 @@ def attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
                          "position 0); use impl='xla' for shard-offset "
                          "causal masking")
     if impl == "auto":
-        # 'axon' is this session's TPU-via-tunnel platform name
-        use_flash = (jax.default_backend() in ("tpu", "axon")
-                     and q.ndim == 4 and _zero(q_offset)
-                     and _zero(k_offset)
-                     and d % 128 == 0 and q.shape[-2] % 128 == 0
-                     and k.shape[-2] % 128 == 0)
+        use_flash = (_zero(q_offset) and _zero(k_offset)
+                     and flash_eligible(q.shape, k.shape))
     if use_flash:
-        from jax.experimental.pallas.ops.tpu.flash_attention import \
-            flash_attention
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            BlockSizes, flash_attention)
 
-        return flash_attention(q, k, v, causal=causal, sm_scale=scale)
+        # kernel defaults (128-blocks) underuse the MXU: a 512-block
+        # sweep measured 3.0x faster fwd+bwd at B=8,H=16,S=2048,D=128
+        # on v5e (17ms vs 51ms; 1024 and mixed blocks were worse) —
+        # PERF.md §11.  Blocks must divide the (128-aligned) seq lens.
+        def _blk(s):
+            return max(b for b in (512, 256, 128) if s % b == 0)
+
+        bq, bk = _blk(q.shape[-2]), _blk(k.shape[-2])
+        bs = BlockSizes(
+            block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+            block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+            block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk,
+            block_q_dq=bq)
+        return flash_attention(q, k, v, causal=causal, sm_scale=scale,
+                               block_sizes=bs)
     s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
     if causal:
         qi = q_offset + jnp.arange(q.shape[-2])
@@ -103,16 +127,29 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     (``ppermute``); an online softmax (running max ``m``, normalizer
     ``l``, accumulator ``o`` — the flash-attention recurrence) makes the
     streaming accumulation exact, not approximate.
-    """
-    import jax
+
+    Training-safe: a ``jax.custom_vjp`` backward runs a SECOND ring pass
+    that recomputes each hop's score block from the saved per-row
+    logsumexp (the flash-attention backward) with the dK/dV accumulators
+    riding the ring alongside their K/V blocks — per-device memory stays
+    O(seq/n) in backward too, instead of reverse-mode-through-
+    ``fori_loop`` checkpointing every hop's rotated K/V (O(global seq),
+    the round-3 VERDICT §5.7 gap)."""
+    d = q.shape[-1]
+    scale = (1.0 / d ** 0.5) if scale is None else scale
+    return _ring_attention_vjp(axis_name, bool(causal), float(scale))(
+        q, k, v)
+
+
+def _ring_fwd_pass(q, k, v, axis_name, causal, scale):
+    """Online-softmax ring forward; returns (out, lse) with lse the
+    per-row logsumexp of the GLOBAL score row (the flash residual)."""
     import jax.numpy as jnp
     from jax import lax
 
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     bq = q.shape[-2]
-    d = q.shape[-1]
-    scale = (1.0 / d ** 0.5) if scale is None else scale
     neg = _neg_inf(jnp.float32)
 
     q32 = q.astype(jnp.float32)
@@ -150,7 +187,76 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         return kk, vv, jnp.maximum(m, m_new), l, o
 
     _, _, m, l, o = lax.fori_loop(0, n, body, (k, v, m, l, o))
-    return (o / jnp.where(l == 0.0, 1.0, l)[..., None]).astype(q.dtype)
+    out = (o / jnp.where(l == 0.0, 1.0, l)[..., None]).astype(q.dtype)
+    # fully-masked rows (l == 0): lse = +inf so exp(s - lse) == 0 in bwd
+    lse = jnp.where(l == 0.0, jnp.inf, m + jnp.log(
+        jnp.where(l == 0.0, 1.0, l)))
+    return out, lse
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_attention_vjp(axis_name, causal, scale):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        return _ring_fwd_pass(q, k, v, axis_name, causal, scale)[0]
+
+    def f_fwd(q, k, v):
+        out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, scale)
+        return out, (q, k, v, out, lse)
+
+    def f_bwd(res, do):
+        q, k, v, out, lse = res
+        n = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+        bq = q.shape[-2]
+        neg = _neg_inf(jnp.float32)
+        q32 = q.astype(jnp.float32)
+        do32 = do.astype(jnp.float32)
+        # delta[r] = Σ_d dO[r,d]·O[r,d] — the softmax-jacobian row term
+        delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)
+
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        q_off = idx * bq
+        dq0 = jnp.zeros_like(q32)
+        dk0 = jnp.zeros_like(q32, shape=k.shape)
+        dv0 = jnp.zeros_like(q32, shape=v.shape)
+
+        def body(step, carry):
+            kk, vv, dk, dv, dq = carry
+            owner = (idx - step) % n
+            kk32 = kk.astype(jnp.float32)
+            s = jnp.einsum("...qd,...kd->...qk", q32, kk32) * scale
+            if causal:
+                qi = q_off + jnp.arange(bq)
+                ki = owner * kk.shape[-2] + jnp.arange(kk.shape[-2])
+                s = jnp.where(qi[:, None] >= ki[None, :], s, neg)
+            # exact probabilities from the saved logsumexp
+            p = jnp.exp(s - lse[..., None])
+            dv_c = jnp.einsum("...qk,...qd->...kd", p, do32)
+            dp = jnp.einsum("...qd,...kd->...qk", do32,
+                            vv.astype(jnp.float32))
+            ds = p * (dp - delta[..., None]) * scale
+            dq = dq + jnp.einsum("...qk,...kd->...qd", ds, kk32)
+            dk_c = jnp.einsum("...qk,...qd->...kd", ds, q32)
+            # dK/dV accumulators travel WITH their block: after n hops
+            # they are back home with every device's contribution
+            kk = lax.ppermute(kk, axis_name, perm)
+            vv = lax.ppermute(vv, axis_name, perm)
+            dk = lax.ppermute(dk + dk_c, axis_name, perm)
+            dv = lax.ppermute(dv + dv_c, axis_name, perm)
+            return kk, vv, dk, dv, dq
+
+        _, _, dk, dv, dq = lax.fori_loop(
+            0, n, body, (k, v, dk0, dv0, dq0))
+        return (dq.astype(q.dtype), dk.astype(k.dtype),
+                dv.astype(v.dtype))
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,

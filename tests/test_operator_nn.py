@@ -851,6 +851,8 @@ COVERED_ELSEWHERE = {
     "Custom": "test_custom_op.py",
     "_contrib_DotProductAttention": "test_transformer.py",
     "DotProductAttention": "test_transformer.py",
+    "_contrib_SoftmaxXentHead": "test_transformer.py",
+    "SoftmaxXentHead": "test_transformer.py",
     "Correlation": "test_contrib_vision.py",
     "_contrib_CTCLoss": "test_contrib_vision.py",
     "CTCLoss": "test_contrib_vision.py",

@@ -116,3 +116,83 @@ def test_moe_composes_with_dp():
                       jnp.asarray(w1s), jnp.asarray(w2s))
     ref = _moe_oracle(x, gate_w, w1s, w2s)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# PipelineTrainStep: real pipelined training
+# ---------------------------------------------------------------------------
+
+def test_pipeline_train_step_matches_single_device():
+    """A 4-stage pipelined LM (4 microbatches, fused head, adam) tracks
+    the single-device FusedTrainStep loss curve on identical params and
+    data — pipelined training is the same training."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.parallel.pipeline import PipelineTrainStep
+
+    V, E, H, L, S, B, M = 16, 16, 2, 4, 8, 8, 4
+    rng = np.random.RandomState(0)
+
+    net = mx.models.transformer_lm(vocab_size=V, embed=E, heads=H,
+                                   num_layers=L, seq_len=S,
+                                   batch_size=B, head="fused")
+    mx.random.seed(11)
+    fused = parallel.FusedTrainStep(
+        net, {"data": (B, S)}, {"softmax_label": (B, S)},
+        mesh=parallel.default_mesh(1), optimizer="adam",
+        optimizer_params={"learning_rate": 3e-3},
+        initializer=mx.initializer.Xavier())
+
+    mesh = build_mesh({"pp": 4})
+    pp = PipelineTrainStep(mesh, vocab_size=V, embed=E, heads=H,
+                           num_layers=L, seq_len=S, batch_size=B,
+                           num_microbatches=M, optimizer="adam",
+                           optimizer_params={"learning_rate": 3e-3})
+    # identical starting point: copy the fused step's params in
+    arg_params, _ = fused.get_params()
+    pp.set_params(arg_params)
+
+    toks = rng.randint(0, V, (6, B, S)).astype(np.float32)
+    labs = (toks + 1) % V
+    for step_i in range(6):
+        batch = {"data": toks[step_i], "softmax_label": labs[step_i]}
+        outs = fused(batch)
+        fused_loss = float(np.asarray(outs[0]).mean())
+        pp_loss = pp(batch)
+        np.testing.assert_allclose(pp_loss, fused_loss, rtol=2e-4,
+                                   atol=2e-5,
+                                   err_msg="step %d" % step_i)
+    # parameters stay in lockstep too (spot-check two tensors)
+    pa = pp.get_params()
+    fa, _ = fused.get_params()
+    for name in ("block0_q_weight", "lm_head_weight"):
+        np.testing.assert_allclose(pa[name].asnumpy(),
+                                   fa[name].asnumpy(), rtol=2e-3,
+                                   atol=2e-5, err_msg=name)
+
+
+def test_pipeline_train_step_learns():
+    """The pipelined trainer actually learns the shift task."""
+    from incubator_mxnet_tpu.parallel.pipeline import PipelineTrainStep
+
+    V, E, H, L, S, B, M = 16, 32, 4, 4, 12, 8, 4
+    mesh = build_mesh({"pp": 4})
+    import incubator_mxnet_tpu as mx
+
+    mx.random.seed(3)
+    pp = PipelineTrainStep(mesh, vocab_size=V, embed=E, heads=H,
+                           num_layers=L, seq_len=S, batch_size=B,
+                           num_microbatches=M, optimizer="adam",
+                           optimizer_params={"learning_rate": 3e-3},
+                           initializer=mx.initializer.Xavier())
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, V, (64, S)).astype(np.float32)
+    data_b = tokens.reshape(8, B, S)
+    label_b = (data_b + 1) % V
+    loss = None
+    for epoch in range(30):
+        for i in range(8):
+            loss = pp({"data": data_b[i], "softmax_label": label_b[i]})
+        if loss < 0.05:
+            break
+    assert loss < 0.05, "pipelined LM failed to learn: %.3f" % loss

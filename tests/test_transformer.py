@@ -203,3 +203,169 @@ def test_transformer_lm_bf16_forward():
     o16 = ex16.forward(is_train=False)[0].asnumpy()
     assert o16.dtype == np.float32  # logits cast back before softmax
     np.testing.assert_allclose(o16, o32, rtol=0.08, atol=0.02)
+
+
+# ---------------------------------------------------------------------------
+# Fused chunked softmax-xent head
+# ---------------------------------------------------------------------------
+
+def _sxh_apply(x, w, lab, attrs):
+    op = get_op("_contrib_SoftmaxXentHead")
+    (loss,), _ = op.apply([x, w, lab], attrs, OpContext(is_train=True))
+    return loss
+
+
+@pytest.mark.parametrize("chunk", ["0", "8"])
+def test_softmax_xent_head_matches_oracle(chunk):
+    """Forward loss == -log softmax(x·Wᵀ)[label]; backward emits the
+    SoftmaxOutput-convention gradient (p - onehot), chunked and
+    unchunked identically."""
+    rng = np.random.RandomState(0)
+    N, E, V = 24, 16, 11
+    x = jnp.asarray(rng.randn(N, E).astype(np.float32))
+    w = jnp.asarray(rng.randn(V, E).astype(np.float32) * 0.3)
+    lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.float32))
+    attrs = {"num_hidden": str(V), "chunk": chunk}
+
+    loss = np.asarray(_sxh_apply(x, w, lab, attrs))
+    logits = np.asarray(x) @ np.asarray(w).T
+    lse = np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                 .sum(-1)) + logits.max(-1)
+    oracle = lse - logits[np.arange(N), np.asarray(lab, np.int32)]
+    np.testing.assert_allclose(loss, oracle, rtol=1e-5, atol=1e-5)
+
+    # backward: loss-head convention — out_grad ignored, gradient is
+    # (p - onehot) pushed through the projection
+    def head_sum(x, w):
+        return jnp.sum(_sxh_apply(x, w, lab, attrs))
+
+    dx, dw = jax.grad(head_sum, argnums=(0, 1))(x, w)
+    p = np.exp(logits - lse[:, None])
+    d = p.copy()
+    d[np.arange(N), np.asarray(lab, np.int32)] -= 1.0
+    np.testing.assert_allclose(np.asarray(dx), d @ np.asarray(w),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw), d.T @ np.asarray(x),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_xent_head_ignore_and_normalize():
+    """use_ignore masks rows out of loss and gradient; normalization
+    'valid' divides by the non-ignored count."""
+    rng = np.random.RandomState(1)
+    N, E, V = 12, 8, 7
+    x = jnp.asarray(rng.randn(N, E).astype(np.float32))
+    w = jnp.asarray(rng.randn(V, E).astype(np.float32) * 0.3)
+    lab_np = rng.randint(0, V, (N,)).astype(np.float32)
+    lab_np[::3] = -1.0  # ignored rows
+    lab = jnp.asarray(lab_np)
+    attrs = {"num_hidden": str(V), "use_ignore": "True",
+             "ignore_label": "-1", "normalization": "valid",
+             "chunk": "4"}
+
+    loss = np.asarray(_sxh_apply(x, w, lab, attrs))
+    assert (loss[::3] == 0).all()
+    assert (loss[1::3] > 0).all()
+
+    dx = jax.grad(lambda x: jnp.sum(_sxh_apply(x, w, lab, attrs)))(x)
+    dx = np.asarray(dx)
+    assert np.abs(dx[::3]).max() == 0.0
+    # valid normalization: gradient of a kept row == unnormalized/valid_n
+    attrs_plain = {"num_hidden": str(V), "use_ignore": "True",
+                   "ignore_label": "-1", "chunk": "4"}
+    dx_plain = np.asarray(jax.grad(
+        lambda x: jnp.sum(_sxh_apply(x, w, lab, attrs_plain)))(x))
+    valid_n = (lab_np != -1).sum()
+    np.testing.assert_allclose(dx[1::3], dx_plain[1::3] / valid_n,
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_softmax_xent_head_bf16_path():
+    """bf16 activations: f32-accumulated matmuls keep the loss close to
+    the f32 oracle; dx is bf16, dW is f32 (master dtype)."""
+    rng = np.random.RandomState(2)
+    N, E, V = 16, 8, 9
+    x32 = rng.randn(N, E).astype(np.float32)
+    w32 = (rng.randn(V, E) * 0.3).astype(np.float32)
+    lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.float32))
+    attrs = {"num_hidden": str(V), "chunk": "4"}
+    x = jnp.asarray(x32).astype(jnp.bfloat16)
+    w = jnp.asarray(w32)
+
+    loss = _sxh_apply(x, w, lab, attrs)
+    assert loss.dtype == jnp.float32
+    loss32 = _sxh_apply(jnp.asarray(x32), w, lab, attrs)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(loss32),
+                               rtol=0.05, atol=0.05)
+    dx, dw = jax.grad(
+        lambda x, w: jnp.sum(_sxh_apply(x, w, lab, attrs)),
+        argnums=(0, 1))(x, w)
+    assert dx.dtype == jnp.bfloat16
+    assert dw.dtype == jnp.float32
+
+
+def test_transformer_fused_head_matches_softmax_head():
+    """head='fused' loss per position equals -log p[label] computed from
+    the head='softmax' probabilities on identical params."""
+    V, B, S = 13, 2, 8
+    kw = dict(vocab_size=V, embed=16, heads=2, num_layers=1,
+              seq_len=S, batch_size=B)
+    net_sm = mx.models.transformer_lm(**kw)
+    net_fu = mx.models.transformer_lm(head="fused", **kw)
+    rng = np.random.RandomState(7)
+    shapes = dict(data=(B, S), softmax_label=(B, S))
+    ex_sm = net_sm.simple_bind(grad_req="null", **shapes)
+    ex_fu = net_fu.simple_bind(grad_req="null", **shapes)
+    # fused head names the projection lm_head_weight like FullyConnected
+    assert "lm_head_weight" in ex_fu.arg_dict
+    for n in ex_sm.arg_dict:
+        if n in shapes:
+            continue
+        if n == "lm_head_bias":  # fused head is bias-free; zero it
+            ex_sm.arg_dict[n][:] = mx.nd.zeros(ex_sm.arg_dict[n].shape)
+            continue
+        v = rng.uniform(-0.2, 0.2,
+                        ex_sm.arg_dict[n].shape).astype(np.float32)
+        ex_sm.arg_dict[n][:] = mx.nd.array(v)
+        ex_fu.arg_dict[n][:] = mx.nd.array(v)
+    toks = rng.randint(0, V, (B, S)).astype(np.float32)
+    labs = ((toks + 1) % V).astype(np.float32)
+    for ex in (ex_sm, ex_fu):
+        ex.arg_dict["data"][:] = mx.nd.array(toks)
+        ex.arg_dict["softmax_label"][:] = mx.nd.array(labs)
+    probs = ex_sm.forward(is_train=False)[0].asnumpy()
+    loss = ex_fu.forward(is_train=False)[0].asnumpy()
+    nll = -np.log(probs[np.arange(B * S),
+                        labs.reshape(-1).astype(np.int32)] + 1e-30)
+    np.testing.assert_allclose(loss, nll, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_transformer_fused_head_learns_shift_task():
+    """The fused head trains end-to-end through FusedTrainStep: loss on
+    the shift task drops to near zero (task is deterministic)."""
+    from incubator_mxnet_tpu import parallel
+
+    V, B, S = 16, 8, 12
+    rng = np.random.RandomState(0)
+    net = mx.models.transformer_lm(vocab_size=V, embed=32, heads=4,
+                                   num_layers=2, seq_len=S,
+                                   batch_size=B, head="fused")
+    mx.random.seed(3)
+    step = parallel.FusedTrainStep(
+        net, {"data": (B, S)}, {"softmax_label": (B, S)},
+        mesh=parallel.default_mesh(1), optimizer="adam",
+        optimizer_params={"learning_rate": 3e-3},
+        initializer=mx.initializer.Xavier())
+    tokens = rng.randint(0, V, (64, S)).astype(np.float32)
+    data_b = tokens.reshape(8, B, S)
+    label_b = (data_b + 1) % V
+    loss = None
+    for epoch in range(30):
+        for b in range(8):
+            outs = step({"data": data_b[b],
+                         "softmax_label": label_b[b]})
+        loss = float(np.asarray(outs[0]).mean())
+        if loss < 0.05:
+            break
+    assert loss < 0.05, "fused-head LM failed to learn: loss=%.3f" % loss

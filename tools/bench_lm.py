@@ -1,13 +1,18 @@
 #!/usr/bin/env python
-"""Transformer LM training benchmark (tokens/s, readback-fenced).
+"""Transformer LM training benchmark (tokens/s + MFU, readback-fenced).
 
 The long-context counterpart of ``bench.py`` (PERF.md §8c): a decoder-
 only LM through ``FusedTrainStep``, attention on the Pallas flash kernel
-for lane-aligned shapes.  Prints one JSON line.
+for lane-aligned shapes, and (default) the fused chunked softmax-xent
+head that never materializes the (B·S, V) logits.  Prints one JSON line
+including model-FLOPs-based MFU against both the chip's measured
+sustained matmul rate and its nominal peak.
 
 Env: TP_LM_BATCH (8), TP_LM_SEQ (2048), TP_LM_EMBED (512),
 TP_LM_LAYERS (4), TP_LM_VOCAB (32000), TP_LM_STEPS (10),
-TP_LM_DTYPE (bfloat16), TP_LM_SMALL=1 (CPU smoke).
+TP_LM_DTYPE (bfloat16), TP_LM_HEAD (fused|softmax), TP_LM_SMALL=1
+(CPU smoke), TP_SUSTAINED_TFLOPS (154, PERF.md §10),
+TP_PEAK_TFLOPS (197, v5e bf16 nominal).
 """
 from __future__ import annotations
 
@@ -22,6 +27,31 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def lm_train_step_flops(batch, seq, embed, layers, vocab,
+                        causal_skips_masked=False):
+    """Model FLOPs for ONE training step (fwd + bwd = 3× fwd matmul
+    FLOPs; backward re-derives both dX and dW for every matmul).
+
+    Counted per forward pass:
+    - per-layer projections: q/k/v/out 4·(2·N·E²) + ffn 2·(2·N·E·4E)
+      = 24·N·E²  (N = B·S tokens)
+    - attention: QKᵀ and PV, 2·(2·B·S²·E) per layer — halved ONLY when
+      ``causal_skips_masked`` (the Pallas flash kernel skips masked
+      blocks; the dense xla fallback executes the full S² work).  The
+      halving keeps MFU an *executed*-FLOPs utilization, not a paper
+      number, and the caller must assert which kernel actually runs.
+    - head: 2·N·E·V
+    Embedding gathers are not matmul FLOPs and are excluded.
+    """
+    n = batch * seq
+    proj = 24.0 * n * embed * embed * layers
+    att = 4.0 * batch * seq * seq * embed * layers
+    if causal_skips_masked:
+        att /= 2.0
+    head = 2.0 * n * embed * vocab
+    return 3.0 * (proj + att + head)
+
+
 def main():
     small = os.environ.get("TP_LM_SMALL") == "1"
     B = int(os.environ.get("TP_LM_BATCH", "2" if small else "8"))
@@ -32,6 +62,9 @@ def main():
     steps = int(os.environ.get("TP_LM_STEPS", "2" if small else "10"))
     dtype = os.environ.get("TP_LM_DTYPE",
                            "float32" if small else "bfloat16")
+    head = os.environ.get("TP_LM_HEAD", "fused")
+    sustained = float(os.environ.get("TP_SUSTAINED_TFLOPS", "154"))
+    peak = float(os.environ.get("TP_PEAK_TFLOPS", "197"))
 
     import jax
 
@@ -46,7 +79,7 @@ def main():
                      if E % h == 0)
     net = mx.models.transformer_lm(
         vocab_size=V, embed=E, heads=heads,
-        num_layers=L, seq_len=S, batch_size=B, dtype=dtype)
+        num_layers=L, seq_len=S, batch_size=B, dtype=dtype, head=head)
     step = parallel.FusedTrainStep(
         net, {"data": (B, S)}, {"softmax_label": (B, S)},
         mesh=parallel.default_mesh(1), optimizer="adam",
@@ -69,12 +102,24 @@ def main():
         step(bd)
     sync()
     dt = time.perf_counter() - t0
+    # flash (block-skipping) runs only when attention(impl='auto')
+    # takes the Pallas path — ask THE gate, don't re-derive it
+    from incubator_mxnet_tpu.parallel.sequence import flash_eligible
+
+    att_shape = (B, heads, S, E // heads)
+    flash = flash_eligible(att_shape, att_shape)
+    step_flops = lm_train_step_flops(B, S, E, L, V,
+                                     causal_skips_masked=flash)
+    tflops = step_flops * steps / dt / 1e12
     print(json.dumps({
         "metric": "transformer_lm_train_tokens_per_sec",
         "value": round(B * S * steps / dt, 1),
         "unit": "tokens/s",
         "batch": B, "seq_len": S, "embed": E, "layers": L,
-        "vocab": V, "dtype": dtype}))
+        "vocab": V, "dtype": dtype, "head": head,
+        "model_tflops_per_sec": round(tflops, 1),
+        "mfu_vs_sustained": round(tflops / sustained, 3),
+        "mfu_vs_peak": round(tflops / peak, 3)}))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Aggregate device-op time from a jax.profiler xplane trace.
+
+Usage: python tools/parse_xplane.py <logdir> [top_n]
+
+Finds the newest ``*.xplane.pb`` under ``logdir``, sums event durations
+per HLO op on every device plane, and prints the top-N ops with their
+share — the round-over-round roofline workflow behind PERF.md §3/§8.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import sys
+from collections import Counter
+
+
+def parse(logdir: str, top_n: int = 20) -> None:
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = sorted(glob.glob(os.path.join(
+        logdir, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime)
+    if not paths:
+        raise SystemExit("no .xplane.pb under %s" % logdir)
+    space = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        space.ParseFromString(f.read())
+
+    for plane in space.planes:
+        if "TPU" not in plane.name and "/device" not in plane.name.lower():
+            continue
+        agg = Counter()
+        meta = {i: m.name for i, m in plane.event_metadata.items()}
+        for line in plane.lines:
+            for ev in line.events:
+                agg[meta.get(ev.metadata_id, "?")] += ev.duration_ps
+        if not agg:
+            continue
+        total = sum(agg.values())
+        print("PLANE: %s  lines: %d" % (plane.name, len(plane.lines)))
+        print("total device op time: %.1f ms" % (total / 1e9))
+        for op, ps in agg.most_common(top_n):
+            print("  %8.2f ms %5.1f%%  %s"
+                  % (ps / 1e9, 100 * ps / total, op[:160]))
+
+
+if __name__ == "__main__":
+    parse(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 20)
