@@ -53,8 +53,18 @@ def main():
     # for the reference-form A/B
     stem = os.environ.get("TP_BENCH_STEM", "s2d")
     flat_opt = os.environ.get("TP_BENCH_FLATOPT") == "1"
+    # BN roofline A/B (PERF.md §17): TP_BENCH_BN=ghost<k> subsamples
+    # batch statistics to 1/k of the activation read; =frozen uses the
+    # moving stats (the affine-only / BN-folded limit, no stat reduce)
+    bn_mode = os.environ.get("TP_BENCH_BN", "")
+    bn_extra = {}
+    if bn_mode.startswith("ghost"):
+        bn_extra = {"ghost_sample": int(bn_mode[5:] or 4)}
+    elif bn_mode == "frozen":
+        bn_extra = {"use_global_stats": True}
     net = mx.models.resnet(num_layers=layers, num_classes=classes,
                            image_shape=image, layout=layout, stem=stem,
+                           bn_extra=bn_extra,
                            dtype="float32" if small else "bfloat16")
     image = mx.models.image_data_shape(image, layout)
     mesh = parallel.default_mesh(1)
@@ -116,6 +126,8 @@ def main():
         record["mfu_vs_peak"] = round(tflops / peak, 3)
     if flat_opt:
         record["flat_optimizer"] = True
+    if bn_mode:
+        record["bn_mode"] = bn_mode
     print(json.dumps(record))
 
 

@@ -29,26 +29,35 @@ def _bn_axis(layout):
 
 def residual_unit(data, num_filter, stride, dim_match, name,
                   bottle_neck=True, bn_mom=0.9, workspace=256,
-                  memonger=False, layout="NCHW"):
-    """A residual block (pre-activation, v2 — reference residual_unit)."""
+                  memonger=False, layout="NCHW", bn_extra=None):
+    """A residual block (pre-activation, v2 — reference residual_unit).
+
+    ``bn_extra``: extra attrs applied to every BatchNorm (e.g.
+    ``{"ghost_sample": 4}`` for subsampled statistics, or
+    ``{"use_global_stats": True}`` for the affine-only/frozen limit) —
+    the HBM-roofline experiment knob, PERF.md §17."""
     ax = _bn_axis(layout)
+    bn_extra = bn_extra or {}
     if bottle_neck:
         bn1 = sym.BatchNorm(data, fix_gamma=False, eps=2e-5, axis=ax,
-                            momentum=bn_mom, name=name + "_bn1")
+                            momentum=bn_mom, name=name + "_bn1",
+                            **bn_extra)
         act1 = sym.Activation(bn1, act_type="relu", name=name + "_relu1")
         conv1 = sym.Convolution(act1, num_filter=int(num_filter * 0.25),
                                 kernel=(1, 1), stride=(1, 1), pad=(0, 0),
                                 no_bias=True, layout=layout,
                                 name=name + "_conv1")
         bn2 = sym.BatchNorm(conv1, fix_gamma=False, eps=2e-5, axis=ax,
-                            momentum=bn_mom, name=name + "_bn2")
+                            momentum=bn_mom, name=name + "_bn2",
+                            **bn_extra)
         act2 = sym.Activation(bn2, act_type="relu", name=name + "_relu2")
         conv2 = sym.Convolution(act2, num_filter=int(num_filter * 0.25),
                                 kernel=(3, 3), stride=stride, pad=(1, 1),
                                 no_bias=True, layout=layout,
                                 name=name + "_conv2")
         bn3 = sym.BatchNorm(conv2, fix_gamma=False, eps=2e-5, axis=ax,
-                            momentum=bn_mom, name=name + "_bn3")
+                            momentum=bn_mom, name=name + "_bn3",
+                            **bn_extra)
         act3 = sym.Activation(bn3, act_type="relu", name=name + "_relu3")
         conv3 = sym.Convolution(act3, num_filter=num_filter, kernel=(1, 1),
                                 stride=(1, 1), pad=(0, 0), no_bias=True,
@@ -62,13 +71,13 @@ def residual_unit(data, num_filter, stride, dim_match, name,
                                        name=name + "_sc")
         return conv3 + shortcut
     bn1 = sym.BatchNorm(data, fix_gamma=False, momentum=bn_mom, eps=2e-5,
-                        axis=ax, name=name + "_bn1")
+                        axis=ax, name=name + "_bn1", **bn_extra)
     act1 = sym.Activation(bn1, act_type="relu", name=name + "_relu1")
     conv1 = sym.Convolution(act1, num_filter=num_filter, kernel=(3, 3),
                             stride=stride, pad=(1, 1), no_bias=True,
                             layout=layout, name=name + "_conv1")
     bn2 = sym.BatchNorm(conv1, fix_gamma=False, momentum=bn_mom, eps=2e-5,
-                        axis=ax, name=name + "_bn2")
+                        axis=ax, name=name + "_bn2", **bn_extra)
     act2 = sym.Activation(bn2, act_type="relu", name=name + "_relu2")
     conv2 = sym.Convolution(act2, num_filter=num_filter, kernel=(3, 3),
                             stride=(1, 1), pad=(1, 1), no_bias=True,
@@ -111,9 +120,10 @@ def _space_to_depth(data, image_shape, layout, block=2):
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
            bottle_neck=True, bn_mom=0.9, workspace=256, dtype="float32",
-           memonger=False, layout="NCHW", stem="7x7"):
+           memonger=False, layout="NCHW", stem="7x7", bn_extra=None):
     num_unit = len(units)
     assert num_unit == num_stages
+    bn_extra = bn_extra or {}
     if stem not in ("7x7", "s2d"):
         raise ValueError("stem must be '7x7' or 's2d', got %r" % (stem,))
     ax = _bn_axis(layout)
@@ -130,7 +140,7 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
         # checkpoint-compatible with the 7×7 stem).
         data = _space_to_depth(data, image_shape, layout)
     data = sym.BatchNorm(data, fix_gamma=True, eps=2e-5, momentum=bn_mom,
-                         axis=ax, name="bn_data")
+                         axis=ax, name="bn_data", **bn_extra)
     if height <= 32:  # cifar-style stem
         body = sym.Convolution(data, num_filter=filter_list[0],
                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
@@ -143,7 +153,7 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
             pad=(1, 1) if s2d else (3, 3),
             no_bias=True, layout=layout, name="conv0")
         body = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, axis=ax,
-                             momentum=bn_mom, name="bn0")
+                             momentum=bn_mom, name="bn0", **bn_extra)
         body = sym.Activation(body, act_type="relu", name="relu0")
         body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
                            pool_type="max", layout=layout)
@@ -154,15 +164,15 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
             (1 if i == 0 else 2, 1 if i == 0 else 2),
             False, name="stage%d_unit%d" % (i + 1, 1),
             bottle_neck=bottle_neck, bn_mom=bn_mom, workspace=workspace,
-            memonger=memonger, layout=layout)
+            memonger=memonger, layout=layout, bn_extra=bn_extra)
         for j in range(units[i] - 1):
             body = residual_unit(body, filter_list[i + 1], (1, 1), True,
                                  name="stage%d_unit%d" % (i + 1, j + 2),
                                  bottle_neck=bottle_neck, bn_mom=bn_mom,
                                  workspace=workspace, memonger=memonger,
-                                 layout=layout)
+                                 layout=layout, bn_extra=bn_extra)
     bn1 = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=bn_mom,
-                        axis=ax, name="bn1")
+                        axis=ax, name="bn1", **bn_extra)
     relu1 = sym.Activation(bn1, act_type="relu", name="relu1")
     pool1 = sym.Pooling(relu1, global_pool=True, kernel=(7, 7),
                         pool_type="avg", layout=layout, name="pool1")

@@ -702,10 +702,19 @@ def _batch_norm(ins, attrs, ctx):
         # cancellation when |mean| >> std: var = E[(x−K)²] − (E[x−K])²
         # is exact for any K and the error term ∝ (mean−K)² vanishes as
         # the moving mean converges.
-        red_n = float(np.prod([data.shape[i] for i in red_axes]))
+        #
+        # ghost_sample=k (HBM-roofline lever, PERF.md §17): statistics
+        # from the first batch/k rows only — the stat reduce reads 1/k
+        # of the activation.  Ghost-BN-style estimator; normalize (and
+        # gradients) still cover the full batch.
+        ghost = parse_int(attrs.get("ghost_sample", 1))
+        xstat = x32
+        if ghost > 1 and data.shape[0] >= ghost:
+            xstat = x32[: data.shape[0] // ghost]
+        red_n = float(np.prod([xstat.shape[i] for i in red_axes]))
         shift = jax.lax.stop_gradient(
             mov_mean.astype(jnp.float32)).reshape(bshape)
-        xs = x32 - shift
+        xs = xstat - shift
         s = jnp.sum(xs, axis=red_axes)
         s2 = jnp.sum(jnp.square(xs), axis=red_axes)
         d = s / red_n

@@ -17,7 +17,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..base import MXNetError, dtype_np
+from ..base import MXNetError, dtype_np, get_env
 from ..ops.registry import OpContext, get_op
 from .mesh import (data_parallel_spec, default_mesh, replicated_spec)
 
@@ -53,7 +53,8 @@ class FusedTrainStep:
                  optimizer_params: Optional[Dict[str, Any]] = None,
                  initializer=None, dtype=None, seed: int = 0,
                  param_partition: Optional[Dict[str, Any]] = None,
-                 flat_optimizer: bool = False, remat=None):
+                 flat_optimizer: bool = False, remat=None,
+                 grad_accum: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -63,6 +64,17 @@ class FusedTrainStep:
         # 'mirror' saves only matmul/conv outputs, int K checkpoints K
         # uniform graph segments (lowering.resolve_remat)
         self.remat = remat
+        # gradient accumulation: k sequential microbatches inside the
+        # ONE jitted step (lax.scan), summed grads, one optimizer
+        # update.  Activation memory ~ batch/k; BN moving stats thread
+        # sequentially through the scan.  The TP_GRAD_ACCUM env applies
+        # only when the caller did not specify — an explicit value
+        # (including 1 = off) always wins.
+        if grad_accum is None:
+            grad_accum = int(get_env("GRAD_ACCUM", 1, int))
+        self._accum = int(grad_accum)
+        if self._accum < 1:
+            raise MXNetError("grad_accum must be >= 1")
         self.mesh = mesh if mesh is not None else default_mesh()
         label_shapes = label_shapes or {}
         shapes = dict(data_shapes)
@@ -75,6 +87,21 @@ class FusedTrainStep:
         self.param_names = [n for n in arg_names if n not in shapes]
         shape_of = dict(zip(arg_names, arg_shapes))
         self.global_batch = shapes[self.input_names[0]][0]
+        if self._accum > 1:
+            if self.global_batch % self._accum:
+                raise MXNetError(
+                    "global batch %d does not divide into %d "
+                    "accumulation microbatches"
+                    % (self.global_batch, self._accum))
+            # microbatching slices axis 0 of EVERY input — a non-batch-
+            # major input (e.g. time-major (T, N) sequences) would be
+            # silently garbled, so require batch-major throughout
+            for n, s in shapes.items():
+                if not s or s[0] != self.global_batch:
+                    raise MXNetError(
+                        "grad_accum requires batch-major inputs; %r has "
+                        "leading dim %s != global batch %d"
+                        % (n, s[0] if s else None, self.global_batch))
 
         # ---- optimizer resolution ---------------------------------------
         opt_params = dict(optimizer_params or {})
@@ -170,16 +197,48 @@ class FusedTrainStep:
 
                 lr = lr * _jnp.sqrt(1.0 - _jnp.power(adam_b2, t)) \
                     / (1.0 - _jnp.power(adam_b1, t))
-            def f(p):
-                args = dict(batch)
-                args.update(p)
-                outs, new_aux = fwd(args, aux, key)
-                return outs, new_aux
+            def micro_grads(p, aux_in, mb, mb_key):
+                def f(p):
+                    args = dict(mb)
+                    args.update(p)
+                    return fwd(args, aux_in, mb_key)
 
-            (outs, new_aux), vjp_fn = jax.vjp(f, params)
-            ct = ([jnp.ones_like(o) for o in outs],
-                  {k: jnp.zeros_like(v) for k, v in new_aux.items()})
-            (grads,) = vjp_fn(ct)
+                (outs, new_aux), vjp_fn = jax.vjp(f, p)
+                ct = ([jnp.ones_like(o) for o in outs],
+                      {k: jnp.zeros_like(v) for k, v in new_aux.items()})
+                (g,) = vjp_fn(ct)
+                return g, outs, new_aux
+
+            if self._accum == 1:
+                grads, outs, new_aux = micro_grads(params, aux, batch, key)
+            else:
+                # k sequential microbatches in ONE program: grads sum,
+                # moving aux threads through the scan carry, outputs
+                # restack to the full batch
+                k = self._accum
+                stacked = {n: v.reshape((k, v.shape[0] // k)
+                                        + tuple(v.shape[1:]))
+                           for n, v in batch.items()}
+
+                def body(carry, mb):
+                    aux_c, gsum, i = carry
+                    g, outs, new_aux = micro_grads(
+                        params, aux_c, mb, jax.random.fold_in(key, i))
+                    gsum = jax.tree_util.tree_map(
+                        lambda a, b: a + b, gsum, g)
+                    return (new_aux, gsum, i + 1), outs
+
+                gzero = {n: jnp.zeros(v.shape, jnp.float32)
+                         for n, v in params.items()}
+                (new_aux, grads, _), outs_stacked = jax.lax.scan(
+                    body, (aux, gzero, jnp.int32(0)), stacked)
+                # batch-axis outputs restack to the full batch; outputs
+                # with no batch axis (e.g. a reduced MakeLoss scalar)
+                # stay stacked per-microbatch, shape (k,)
+                outs = [o.reshape((o.shape[0] * o.shape[1],)
+                                  + tuple(o.shape[2:]))
+                        if o.ndim >= 2 else o
+                        for o in outs_stacked]
 
             attrs = dict(opt_attrs, lr=lr)
             new_params, new_states = {}, {}

@@ -901,3 +901,36 @@ def test_all_ops_covered():
     # nothing claimed as covered that isn't registered
     ghost = sorted((covered - set(list_ops())))
     assert not ghost, "coverage table names unregistered ops: %s" % ghost
+
+
+def test_batchnorm_ghost_sample_stats():
+    """ghost_sample=k: statistics come from the first batch/k rows only
+    (the stat reduce reads 1/k of the activation); normalization covers
+    the full batch.  ghost_sample=1 is exact today's behavior."""
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.registry import OpContext, get_op
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4, 3, 3).astype(np.float32)
+    op = get_op("BatchNorm")
+    gamma = jnp.ones(4)
+    beta = jnp.zeros(4)
+    mm, mv = jnp.zeros(4), jnp.ones(4)
+
+    def run(attrs, xin):
+        (out,), _ = op.apply([jnp.asarray(xin), gamma, beta, mm, mv],
+                             dict(attrs, fix_gamma="False", eps="1e-5"),
+                             OpContext(is_train=True))
+        return np.asarray(out)
+
+    # ghost stats over the first half == full stats of a batch whose
+    # second half duplicates the first
+    x_dup = np.concatenate([x[:4], x[:4]])
+    ghost = run({"ghost_sample": "2"}, x_dup)
+    full_half = run({}, x[:4])
+    np.testing.assert_allclose(ghost[:4], full_half, rtol=1e-5,
+                               atol=1e-6)
+    # and differs from full-batch stats when halves differ
+    assert np.abs(run({"ghost_sample": "2"}, x)
+                  - run({}, x)).max() > 1e-4

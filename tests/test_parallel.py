@@ -210,3 +210,86 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
         np.testing.assert_allclose(np.asarray(step2.params[k]),
                                    np.asarray(step.params[k]),
                                    rtol=1e-6, atol=1e-7, err_msg=k)
+
+
+def test_grad_accum_matches_full_batch():
+    """grad_accum=k sums microbatch gradients into ONE update — exactly
+    the full-batch step for BN-free nets (BN nets get microbatch
+    statistics, the standard grad-accum semantics)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+
+    d = mx.sym.Variable("data")
+    x = mx.sym.FullyConnected(d, num_hidden=16, name="fc1")
+    x = mx.sym.Activation(x, act_type="relu", name="r1")
+    x = mx.sym.FullyConnected(x, num_hidden=5, name="fc2")
+    net = mx.sym.SoftmaxOutput(x, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+    rng = np.random.RandomState(0)
+    batch = {"data": rng.randn(8, 12).astype(np.float32),
+             "softmax_label": rng.randint(0, 5, (8,)).astype(np.float32)}
+    results = {}
+    for accum in (1, 4):
+        mx.random.seed(0)
+        step = parallel.FusedTrainStep(
+            net, {"data": (8, 12)}, {"softmax_label": (8,)},
+            mesh=parallel.default_mesh(1), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(), seed=0,
+            grad_accum=accum)
+        outs = None
+        for _ in range(3):
+            outs = step(batch)
+        results[accum] = (
+            {n: np.asarray(v) for n, v in step.params.items()},
+            np.asarray(outs[0]))
+    p1, o1 = results[1]
+    p4, o4 = results[4]
+    assert o4.shape == o1.shape  # outputs restack to the full batch
+    for n in p1:
+        np.testing.assert_allclose(p1[n], p4[n], rtol=1e-5, atol=1e-7,
+                                   err_msg=n)
+    np.testing.assert_allclose(o1, o4, rtol=1e-4, atol=1e-6)
+
+
+def test_grad_accum_guards():
+    """Explicit grad_accum wins over env; non-batch-major inputs and
+    indivisible batches are refused with clear errors."""
+    import os
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.base import MXNetError
+
+    d = mx.sym.Variable("data")
+    net = mx.sym.LinearRegressionOutput(
+        mx.sym.FullyConnected(d, num_hidden=4, name="fc"),
+        mx.sym.Variable("label"), name="lro")
+
+    os.environ["TP_GRAD_ACCUM"] = "4"
+    try:
+        step = parallel.FusedTrainStep(
+            net, {"data": (8, 6)}, {"label": (8, 4)},
+            mesh=parallel.default_mesh(1), grad_accum=1)
+        assert step._accum == 1  # explicit 1 pins accumulation OFF
+        step_env = parallel.FusedTrainStep(
+            net, {"data": (8, 6)}, {"label": (8, 4)},
+            mesh=parallel.default_mesh(1))
+        assert step_env._accum == 4  # unspecified -> env applies
+    finally:
+        del os.environ["TP_GRAD_ACCUM"]
+
+    with pytest.raises(MXNetError, match="does not divide"):
+        parallel.FusedTrainStep(net, {"data": (8, 6)},
+                                {"label": (8, 4)},
+                                mesh=parallel.default_mesh(1),
+                                grad_accum=3)
+    # time-major label (leading dim != batch) must be refused
+    net2 = mx.sym.LinearRegressionOutput(
+        mx.sym.FullyConnected(d, num_hidden=8, name="fc"),
+        mx.sym.Variable("label"), name="lro")
+    with pytest.raises(MXNetError, match="batch-major"):
+        parallel.FusedTrainStep(net2, {"data": (8, 6)},
+                                {"label": (4, 16)},
+                                mesh=parallel.default_mesh(1),
+                                grad_accum=2)
