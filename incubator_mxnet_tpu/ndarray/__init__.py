@@ -198,60 +198,120 @@ def onehot_encode(indices: NDArray, out: NDArray) -> NDArray:
 
 
 # ---------------------------------------------------------------------------
-# Serialization (NDArray container format analog of
-# ``src/ndarray/ndarray.cc:668-744`` — magic + per-array shape/dtype/data;
-# same two-call API ``mx.nd.save/load``)
+# Serialization — the GENUINE reference container format, byte for byte
+# (``src/ndarray/ndarray.cc:668-744``): u64 kMXAPINDArrayListMagic +
+# u64 reserved, dmlc vector<NDArray> (u64 count; per array u32
+# NDARRAY_V1_MAGIC, u32 ndim + i64 dims, i32 dev_type + i32 dev_id,
+# i32 mshadow type_flag, raw data), dmlc vector<string> names.  Files
+# written by MXNet v0.11's ``mx.nd.save`` load here and vice versa;
+# ``load`` also reads the legacy pre-0.9 TShape framing (magic = ndim,
+# u32 dims — ``LegacyTShapeLoad``, ndarray.cc:693) and this repo's
+# round-3 container.
 # ---------------------------------------------------------------------------
 
-_NDARRAY_MAGIC = 0x112
-_FMT_VERSION = 1
+_NDARRAY_MAGIC = 0x112           # kMXAPINDArrayListMagic
+_NDARRAY_V1_MAGIC = 0xF993FAC8   # per-array shape magic
+_FMT_VERSION = 1                 # round-3 own-format version sentinel
+
+# mshadow::TypeFlag (mshadow/base.h) — bf16 postdates v0.11 and has no
+# flag; masters are f32, so bf16 arrays upcast on save
+_TYPE_FLAGS = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+               "int32": 4, "int8": 5, "int64": 6}
+_FLAG_TYPES = {v: k for k, v in _TYPE_FLAGS.items()}
 
 
 def save(fname: str, data) -> None:
-    """Save dict/list of NDArrays (``MXNDArraySave``)."""
+    """Save dict/list of NDArrays (``MXNDArraySave``) in the genuine
+    reference binary format."""
     if isinstance(data, NDArray):
-        names, arrays = [""], [data]
+        names, arrays = [], [data]
     elif isinstance(data, dict):
         names, arrays = list(data.keys()), list(data.values())
     else:
-        names, arrays = [""] * len(data), list(data)
+        names, arrays = [], list(data)
     with open(fname, "wb") as f:
-        f.write(struct.pack("<QQQ", _NDARRAY_MAGIC, _FMT_VERSION,
-                            len(arrays)))
-        for name, arr in zip(names, arrays):
-            nb = name.encode("utf-8")
-            a = arr.asnumpy()
-            dt = a.dtype.name.encode("utf-8")
-            f.write(struct.pack("<I", len(nb)))
-            f.write(nb)
-            f.write(struct.pack("<I", len(dt)))
-            f.write(dt)
+        f.write(struct.pack("<QQ", _NDARRAY_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for arr in arrays:
+            a = np.ascontiguousarray(arr.asnumpy())
+            if a.dtype.name == "bfloat16" or a.dtype.name not in _TYPE_FLAGS:
+                a = a.astype(np.float32)
+            f.write(struct.pack("<I", _NDARRAY_V1_MAGIC))
             f.write(struct.pack("<I", a.ndim))
-            f.write(struct.pack("<%dq" % a.ndim, *a.shape))
-            buf = np.ascontiguousarray(a).tobytes()
-            f.write(struct.pack("<Q", len(buf)))
-            f.write(buf)
+            f.write(struct.pack("<%dq" % a.ndim, *a.shape)
+                    if a.ndim else b"")
+            f.write(struct.pack("<ii", 1, 0))  # Context: kCPU, dev 0
+            f.write(struct.pack("<i", _TYPE_FLAGS[a.dtype.name]))
+            f.write(a.tobytes())
+        f.write(struct.pack("<Q", len(names)))
+        for name in names:
+            nb = name.encode("utf-8")
+            f.write(struct.pack("<Q", len(nb)))
+            f.write(nb)
+
+
+def _load_one_reference(f):
+    (magic,) = struct.unpack("<I", f.read(4))
+    if magic == _NDARRAY_V1_MAGIC:
+        (ndim,) = struct.unpack("<I", f.read(4))
+        shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) \
+            if ndim else ()
+    else:
+        # pre-0.9 legacy TShape: the magic word IS ndim, u32 dims
+        ndim = magic
+        shape = struct.unpack("<%dI" % ndim, f.read(4 * ndim)) \
+            if ndim else ()
+    if ndim == 0:
+        return array(np.zeros((), np.float32))
+    f.read(8)  # Context (dev_type, dev_id) — always loaded to host
+    (type_flag,) = struct.unpack("<i", f.read(4))
+    if type_flag not in _FLAG_TYPES:
+        raise MXNetError("unknown mshadow type flag %d" % type_flag)
+    dt = np.dtype(_FLAG_TYPES[type_flag])
+    n = int(np.prod(shape))
+    a = np.frombuffer(f.read(n * dt.itemsize), dtype=dt).reshape(shape)
+    return array(a, dtype=dt)
 
 
 def load(fname: str):
-    """Load dict/list of NDArrays (``MXNDArrayLoad``)."""
+    """Load dict/list of NDArrays (``MXNDArrayLoad``) — genuine
+    reference files (incl. pre-0.9 shape framing) and this repo's
+    round-3 container."""
     with open(fname, "rb") as f:
-        magic, _ver, count = struct.unpack("<QQQ", f.read(24))
+        magic, word2 = struct.unpack("<QQ", f.read(16))
         if magic != _NDARRAY_MAGIC:
             raise MXNetError("invalid NDArray file %s" % fname)
-        names, arrays = [], []
-        for _ in range(count):
-            (nlen,) = struct.unpack("<I", f.read(4))
-            name = f.read(nlen).decode("utf-8")
-            (dlen,) = struct.unpack("<I", f.read(4))
-            dt = np.dtype(f.read(dlen).decode("utf-8"))
-            (ndim,) = struct.unpack("<I", f.read(4))
-            shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim \
-                else ()
-            (blen,) = struct.unpack("<Q", f.read(8))
-            a = np.frombuffer(f.read(blen), dtype=dt).reshape(shape)
-            names.append(name)
-            arrays.append(array(a, dtype=dt))
+        if word2 == _FMT_VERSION:
+            # round-3 own container (version sentinel; the reference
+            # always writes reserved = 0 here)
+            return _load_own_v1(f)
+        (count,) = struct.unpack("<Q", f.read(8))
+        arrays = [_load_one_reference(f) for _ in range(count)]
+        (nnames,) = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(nnames):
+            (ln,) = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode("utf-8"))
+    if names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+def _load_own_v1(f):
+    (count,) = struct.unpack("<Q", f.read(8))
+    names, arrays = [], []
+    for _ in range(count):
+        (nlen,) = struct.unpack("<I", f.read(4))
+        name = f.read(nlen).decode("utf-8")
+        (dlen,) = struct.unpack("<I", f.read(4))
+        dt = np.dtype(f.read(dlen).decode("utf-8"))
+        (ndim,) = struct.unpack("<I", f.read(4))
+        shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim \
+            else ()
+        (blen,) = struct.unpack("<Q", f.read(8))
+        a = np.frombuffer(f.read(blen), dtype=dt).reshape(shape)
+        names.append(name)
+        arrays.append(array(a, dtype=dt))
     if any(names):
         return dict(zip(names, arrays))
     return arrays

@@ -504,16 +504,49 @@ def ones(shape, dtype=None, **kwargs):
 
 
 def load_json(json_str: str) -> Symbol:
+    """Parse a symbol JSON — current schema AND genuine pre-1.0
+    reference files, applying the legacy upgrades of
+    ``src/nnvm/legacy_json_util.cc``:
+
+    - op params under the old ``param`` key (UpgradeJSON_Parse) and
+      annotation attrs under ``attr`` (ctx_group/lr_mult/...) both
+      merge into the node attrs;
+    - pre-0.9 files omit aux-state variable inputs (e.g. BatchNorm's
+      moving_mean/moving_var): missing trailing inputs are synthesized
+      as ``<node>_<argname>`` variables carrying the node's attr dict
+      (UpgradeJSON_000800_000900, legacy_json_util.cc:116-133);
+    - ``argmin``/``argmax`` with the old ``axis="-1"`` sentinel drop
+      the attr (int → optional<int>, UpgradeJSON_000904_000905).
+    """
     data = json.loads(json_str)
     nodes_meta = data["nodes"]
+    # built[i] maps the i-th JSON node (input/head indices refer to
+    # these positions); synthesized legacy aux variables live outside
     built: List[_Node] = []
     for meta in nodes_meta:
         attrs = dict(meta.get("attrs", meta.get("param", {})) or {})
+        # pre-1.0 annotation attrs live under "attr" (save_000800.json
+        # fixture); op params win on key collisions
+        for k, v in (meta.get("attr") or {}).items():
+            attrs.setdefault(k, v)
         if meta["op"] == "null":
             node = _Node(None, meta["name"], attrs, [])
         else:
             op = get_op(meta["op"])
+            if meta["op"] in ("argmin", "argmax") \
+                    and attrs.get("axis") == "-1":
+                attrs.pop("axis")
             inputs = [(built[i], idx) for i, idx, *_ in meta["inputs"]]
+            # pre-0.9: synthesize missing trailing (aux) variable
+            # inputs under their default names
+            want = op.get_arg_names(attrs)
+            if want is not None:
+                full = list(want) + list(op.aux_names)
+                for miss in range(len(inputs), len(full)):
+                    var = _Node(None,
+                                "%s_%s" % (meta["name"], full[miss]),
+                                dict(attrs), [])
+                    inputs.append((var, 0))
             node = _Node(op, meta["name"], attrs, inputs)
         built.append(node)
     heads = [(built[i], idx) for i, idx, *_ in data["heads"]]
