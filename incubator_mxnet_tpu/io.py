@@ -481,7 +481,22 @@ class ImageRecordIter(DataIter):
             aug = image_mod.CreateAugmenter(
                 data_shape, resize=resize, rand_crop=rand_crop,
                 rand_mirror=rand_mirror, cast=False)
+            # the uint8 chain is exactly decode→[resize]→crop→[flip]:
+            # one native C call covers it (libjpeg decode + bilinear
+            # resize + crop + mirror, GIL-free — the reference's C++
+            # decode stage).  Python chain kept as the fallback for
+            # non-JPEG payloads / missing native lib / undersized
+            # images.  (Native resize is bilinear; the python chain's
+            # inter_method applies only on its fallback path.)
+            if data_shape[0] == 3:
+                self._native_recipe = (int(resize), bool(rand_crop),
+                                       bool(rand_mirror),
+                                       (int(data_shape[1]),
+                                        int(data_shape[2])))
+            else:
+                self._native_recipe = None
         else:
+            self._native_recipe = None
             aug = image_mod.CreateAugmenter(
                 data_shape, resize=resize, rand_crop=rand_crop,
                 rand_mirror=rand_mirror, mean=mean, std=std)
@@ -514,6 +529,32 @@ class ImageRecordIter(DataIter):
         from . import image as image_mod
 
         label, s = item
+        if self._native_recipe is not None:
+            import random as _random
+
+            from . import native
+
+            resize, rand_crop, rand_mirror, (ch, cw) = \
+                self._native_recipe
+            buf = s if isinstance(s, bytes) else bytes(s)
+            cy = cx = -1
+            ok = True
+            if rand_crop:
+                dims = native.decoded_dims(buf, resize)
+                if dims is None or dims[0] < ch or dims[1] < cw:
+                    ok = False
+                else:
+                    cy = _random.randint(0, dims[0] - ch)
+                    cx = _random.randint(0, dims[1] - cw)
+            if ok:
+                flip = rand_mirror and _random.random() < 0.5
+                out = native.decode_resize_crop(
+                    buf, ch, cw, resize=resize, crop_y=cy, crop_x=cx,
+                    flip=flip)
+                if out is not None:
+                    return label, [out]
+            # fall through: python decode+augment path
+
         from .image.image import _imdecode_np
 
         # numpy end-to-end: decode and every augmenter stay on the host

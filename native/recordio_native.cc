@@ -110,3 +110,142 @@ void tp_assemble_chw_f32(const uint8_t** imgs, int64_t n, int64_t h,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// JPEG decode + resize + crop + flip (the reference's C++ image pipeline
+// stage, iter_image_recordio_2.cc decode path).  libjpeg for the decode,
+// bilinear resize, all in one GIL-free call per image.  Compiled only
+// with -DTP_WITH_JPEG -ljpeg (native.py tries that first and falls back
+// to a decoder-less build when jpeg dev files are absent — the symbol
+// is then missing and Python keeps its cv2 path).
+// ---------------------------------------------------------------------------
+#ifdef TP_WITH_JPEG
+#include <csetjmp>
+#include <cstdlib>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct TpJpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void tp_jpeg_fail(j_common_ptr cinfo) {
+  TpJpegErr* err = reinterpret_cast<TpJpegErr*>(cinfo->err);
+  longjmp(err->jb, 1);
+}
+
+// bilinear uint8 RGB resize (src HWC -> dst HWC)
+void tp_resize_bilinear(const uint8_t* src, int sh, int sw,
+                        uint8_t* dst, int dh, int dw) {
+  const float ry = dh > 1 ? (sh - 1.0f) / (dh - 1.0f) : 0.0f;
+  const float rx = dw > 1 ? (sw - 1.0f) / (dw - 1.0f) : 0.0f;
+  for (int y = 0; y < dh; ++y) {
+    const float fy = y * ry;
+    const int y0 = static_cast<int>(fy);
+    const int y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    const float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      const float fx = x * rx;
+      const int x0 = static_cast<int>(fx);
+      const int x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      const float wx = fx - x0;
+      const uint8_t* p00 = src + (y0 * sw + x0) * 3;
+      const uint8_t* p01 = src + (y0 * sw + x1) * 3;
+      const uint8_t* p10 = src + (y1 * sw + x0) * 3;
+      const uint8_t* p11 = src + (y1 * sw + x1) * 3;
+      uint8_t* d = dst + (y * dw + x) * 3;
+      for (int c = 0; c < 3; ++c) {
+        const float top = p00[c] + (p01[c] - p00[c]) * wx;
+        const float bot = p10[c] + (p11[c] - p10[c]) * wx;
+        d[c] = static_cast<uint8_t>(top + (bot - top) * wy + 0.5f);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode a JPEG buffer to RGB, optionally resize so the SHORTER side is
+// `resize` (bilinear), crop out_h x out_w at (crop_y, crop_x) (-1, -1 =
+// center), optionally mirror horizontally; write HWC uint8 into `out`
+// (out_h*out_w*3).  Returns the packed post-resize dims
+// (ih << 32) | iw on success (always > 0), -1 on decode error, -2 if
+// the crop falls out of bounds (caller retries with the python path).
+// One call per image; no Python state touched (ctypes drops the GIL
+// around the call).
+long long tp_decode_resize_crop(const unsigned char* buf, long long len,
+                                long long resize, long long out_h,
+                                long long out_w, long long crop_y,
+                                long long crop_x, long long flip,
+                                unsigned char* out) {
+  jpeg_decompress_struct cinfo;
+  TpJpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = tp_jpeg_fail;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int sw = cinfo.output_width, sh = cinfo.output_height;
+  std::vector<uint8_t> raw(static_cast<size_t>(sw) * sh * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = raw.data() + static_cast<size_t>(
+        cinfo.output_scanline) * sw * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+
+  const uint8_t* img = raw.data();
+  int ih = sh, iw = sw;
+  std::vector<uint8_t> resized;
+  if (resize > 0 && (sh != resize && sw != resize)) {
+    if (sh < sw) {
+      ih = static_cast<int>(resize);
+      iw = static_cast<int>(sw * static_cast<double>(resize) / sh);
+    } else {
+      iw = static_cast<int>(resize);
+      ih = static_cast<int>(sh * static_cast<double>(resize) / sw);
+    }
+    resized.resize(static_cast<size_t>(ih) * iw * 3);
+    tp_resize_bilinear(raw.data(), sh, sw, resized.data(), ih, iw);
+    img = resized.data();
+  }
+
+  long long cy = crop_y, cx = crop_x;
+  if (cy < 0) cy = (ih - out_h) / 2;
+  if (cx < 0) cx = (iw - out_w) / 2;
+  if (cy < 0 || cx < 0 || cy + out_h > ih || cx + out_w > iw) return -2;
+  for (long long y = 0; y < out_h; ++y) {
+    const uint8_t* srow = img + ((cy + y) * iw + cx) * 3;
+    uint8_t* drow = out + y * out_w * 3;
+    if (flip) {
+      for (long long x = 0; x < out_w; ++x) {
+        const uint8_t* p = srow + (out_w - 1 - x) * 3;
+        drow[x * 3 + 0] = p[0];
+        drow[x * 3 + 1] = p[1];
+        drow[x * 3 + 2] = p[2];
+      }
+    } else {
+      std::memcpy(drow, srow, static_cast<size_t>(out_w) * 3);
+    }
+  }
+  return (static_cast<long long>(ih) << 32) | iw;
+}
+
+}  // extern "C"
+#endif  // TP_WITH_JPEG
