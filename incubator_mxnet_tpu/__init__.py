@@ -78,6 +78,7 @@ _OPTIONAL = [
     ("test_utils", ()), ("parallel", ()), ("models", ()), ("gluon", ()),
     ("rnn", ()), ("image", ()), ("operator", ()), ("rtc", ()),
     ("contrib", ()), ("log", ()), ("libinfo", ()), ("torch", ()),
+    ("predictor", ()),
 ]
 
 import importlib as _importlib

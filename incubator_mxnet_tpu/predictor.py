@@ -1,0 +1,147 @@
+"""Minimal load-and-forward inference entry — the C predict API analog.
+
+Reference: ``src/c_api/c_predict_api.cc`` (``MXPredCreate`` :362,
+``MXPredSetInput``, ``MXPredForward``, ``MXPredGetOutput``) — the
+deployment surface that loads a symbol JSON + ``.params`` pair and runs
+forward with NONE of the Module machinery.  TPU-native form: one
+``jax.jit``-compiled forward closed over the loaded parameters, shapes
+fixed at construction (the predict API fixed them at ``MXPredCreate``
+too).
+
+>>> p = Predictor.load("model-symbol.json", "model-0000.params",
+...                    {"data": (1, 3, 224, 224)})
+>>> out = p.predict(data=batch)[0]          # numpy, one call
+>>> p.set_input(data=batch); p.forward()    # or the C-API 3-step form
+>>> out = p.get_output(0)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    """Fixed-shape inference runner over a loaded symbol + params."""
+
+    def __init__(self, symbol, arg_params, aux_params,
+                 input_shapes: Dict[str, Sequence[int]]):
+        import jax
+
+        from .lowering import lower_symbol
+
+        self.symbol = symbol
+        self._input_names = list(input_shapes.keys())
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        for n in self._input_names:
+            if n not in arg_names:
+                raise MXNetError("input %r is not an argument of the "
+                                 "symbol" % (n,))
+        shapes = {n: tuple(s) for n, s in input_shapes.items()}
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+        shape_of = dict(zip(arg_names, arg_shapes))
+
+        def park(src, name, shape):
+            v = src.get(name)
+            if v is None:
+                # label inputs of loss heads are dead at inference
+                # (SoftmaxOutput forward ignores them); the C predict
+                # API bound them to dummy zeros the same way
+                if "label" in name:
+                    return jax.device_put(
+                        np.zeros(shape, dtype=np.float32))
+                raise MXNetError("missing parameter %r" % (name,))
+            a = np.asarray(v.data if hasattr(v, "data") else v,
+                           dtype=np.float32)
+            if tuple(a.shape) != tuple(shape):
+                raise MXNetError(
+                    "parameter %r has shape %s, expected %s"
+                    % (name, a.shape, tuple(shape)))
+            return jax.device_put(a)
+
+        arg_params = arg_params or {}
+        aux_params = aux_params or {}
+        self._params = {n: park(arg_params, n, shape_of[n])
+                        for n in arg_names if n not in shapes}
+        self._aux = {n: park(aux_params, n, s)
+                     for n, s in zip(aux_names, aux_shapes)}
+        self._shapes = shapes
+
+        fwd = lower_symbol(symbol, is_train=False)
+        key = jax.random.PRNGKey(0)
+        params = self._params
+        aux = self._aux
+
+        def run(inputs):
+            args = dict(params)
+            args.update(inputs)
+            outs, _ = fwd(args, aux, key)
+            return outs
+
+        self._run = jax.jit(run)
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Optional[List] = None
+
+    # ------------------------------------------------------------ build
+    @classmethod
+    def load(cls, symbol_file: str, param_file: str,
+             input_shapes: Dict[str, Sequence[int]]) -> "Predictor":
+        """``MXPredCreate`` from the two-file checkpoint: symbol JSON +
+        ``.params`` with ``arg:``/``aux:`` prefixed names (the format
+        ``model.save_checkpoint`` and the reference both write)."""
+        from . import ndarray as nd
+        from . import symbol as sym
+
+        net = sym.load(symbol_file)
+        saved = nd.load(param_file)
+        if not isinstance(saved, dict):
+            raise MXNetError("%s holds an unnamed array list, not a "
+                             "checkpoint" % param_file)
+        arg_params, aux_params = {}, {}
+        for k, v in saved.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:  # bare names: accept as args (predict API did)
+                arg_params[k] = v
+        return cls(net, arg_params, aux_params, input_shapes)
+
+    # ------------------------------------------------------- C-API form
+    def set_input(self, **inputs) -> None:
+        """``MXPredSetInput``: stage named input arrays."""
+        for n, v in inputs.items():
+            if n not in self._shapes:
+                raise MXNetError("unknown input %r (declared: %s)"
+                                 % (n, self._input_names))
+            a = np.asarray(v.data if hasattr(v, "data") else v,
+                           dtype=np.float32)
+            if tuple(a.shape) != self._shapes[n]:
+                raise MXNetError("input %r has shape %s, expected %s"
+                                 % (n, a.shape, self._shapes[n]))
+            self._inputs[n] = a
+        self._outputs = None
+
+    def forward(self) -> None:
+        """``MXPredForward``: run the compiled forward."""
+        missing = [n for n in self._input_names if n not in self._inputs]
+        if missing:
+            raise MXNetError("inputs not set: %s" % missing)
+        self._outputs = list(self._run(self._inputs))
+
+    def get_output(self, index: int = 0) -> np.ndarray:
+        """``MXPredGetOutput``: fetch output ``index`` as numpy."""
+        if self._outputs is None:
+            raise MXNetError("call forward() first")
+        return np.asarray(self._outputs[index])
+
+    # ----------------------------------------------------- one-call form
+    def predict(self, **inputs) -> List[np.ndarray]:
+        self.set_input(**inputs)
+        self.forward()
+        return [np.asarray(o) for o in self._outputs]

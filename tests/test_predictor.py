@@ -1,0 +1,65 @@
+"""Predictor — the C predict API analog (c_predict_api.cc:362):
+load symbol+params, fixed-shape forward, no Module machinery."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.predictor import Predictor
+
+
+def _train_and_checkpoint(tmp_path):
+    """Small trained LeNet-ish net checkpointed the two-file way."""
+    net = mx.models.mlp(num_classes=5)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 1, 28, 28))],
+             label_shapes=[("softmax_label", (8,))])
+    mx.random.seed(0)
+    mod.init_params(mx.initializer.Xavier())
+    arg_params, aux_params = mod.get_params()
+    prefix = str(tmp_path / "model")
+    mx.model.save_checkpoint(prefix, 3, net, arg_params, aux_params)
+    return net, arg_params, aux_params, prefix
+
+
+def test_predictor_matches_module_forward(tmp_path):
+    net, arg_params, aux_params, prefix = _train_and_checkpoint(tmp_path)
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 1, 28, 28).astype(np.float32)
+
+    p = Predictor.load(prefix + "-symbol.json", prefix + "-0003.params",
+                       {"data": (8, 1, 28, 28)})
+    out = p.predict(data=x)[0]
+    assert out.shape == (8, 5)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+    # oracle: the full Module forward on the same params
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        label_names=["softmax_label"])
+    mod.bind(data_shapes=[("data", (8, 1, 28, 28))], for_training=False)
+    mod.set_params(arg_params, aux_params)
+    from incubator_mxnet_tpu.io import DataBatch
+
+    mod.forward(DataBatch([mx.nd.array(x)], []), is_train=False)
+    ref = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    # C-API 3-step form gives the same
+    p.set_input(data=x)
+    p.forward()
+    np.testing.assert_allclose(p.get_output(0), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_predictor_validation(tmp_path):
+    _, _, _, prefix = _train_and_checkpoint(tmp_path)
+    p = Predictor.load(prefix + "-symbol.json", prefix + "-0003.params",
+                       {"data": (2, 1, 28, 28)})
+    with pytest.raises(MXNetError, match="expected"):
+        p.set_input(data=np.zeros((3, 1, 28, 28), np.float32))
+    with pytest.raises(MXNetError, match="unknown input"):
+        p.set_input(bogus=np.zeros((2,), np.float32))
+    with pytest.raises(MXNetError, match="forward"):
+        p.get_output(0)
+    with pytest.raises(MXNetError, match="not set"):
+        p.forward()
