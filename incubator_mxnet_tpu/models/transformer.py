@@ -28,15 +28,30 @@ def _merge_heads(x, batch, seq, embed, name):
 
 
 def _block(x, batch, seq, embed, heads, name, causal=True,
-           attn_impl="auto"):
+           attn_impl="auto", fused_qkv=False):
     head_dim = embed // heads
     ln1 = sym.LayerNorm(x, axis=-1, name=name + "_ln1")
-    qkv = []
-    for part in ("q", "k", "v"):
-        p = sym.FullyConnected(ln1, num_hidden=embed, flatten=False,
-                               no_bias=True, name=name + "_" + part)
-        qkv.append(_split_heads(p, batch, seq, heads, head_dim,
-                                name + "_" + part))
+    if fused_qkv:
+        # one (3E, E) projection instead of three: fewer, larger MXU
+        # calls (param name <block>_qkv_weight — not checkpoint-
+        # compatible with the split form, hence opt-in)
+        p3 = sym.FullyConnected(ln1, num_hidden=3 * embed,
+                                flatten=False, no_bias=True,
+                                name=name + "_qkv")
+        qkv = []
+        for i, part in enumerate(("q", "k", "v")):
+            sl = sym.slice_axis(p3, axis=-1, begin=i * embed,
+                                end=(i + 1) * embed,
+                                name=name + "_" + part + "_slice")
+            qkv.append(_split_heads(sl, batch, seq, heads, head_dim,
+                                    name + "_" + part))
+    else:
+        qkv = []
+        for part in ("q", "k", "v"):
+            p = sym.FullyConnected(ln1, num_hidden=embed, flatten=False,
+                                   no_bias=True, name=name + "_" + part)
+            qkv.append(_split_heads(p, batch, seq, heads, head_dim,
+                                    name + "_" + part))
     att = sym.DotProductAttention(*qkv, causal=causal, impl=attn_impl,
                                   name=name + "_attn")
     att = _merge_heads(att, batch, seq, embed, name + "_attn")
@@ -55,7 +70,8 @@ def _block(x, batch, seq, embed, heads, name, causal=True,
 
 def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
                seq_len=64, batch_size=8, causal=True, dtype="float32",
-               attn_impl="auto", head="softmax", **kwargs):
+               attn_impl="auto", head="softmax", fused_qkv=False,
+               **kwargs):
     """Decoder-only LM.  Inputs ``data`` (B, S) int tokens and
     ``softmax_label`` (B·S,) next-token targets.
 
@@ -93,7 +109,8 @@ def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
         x = sym.Cast(x, dtype=dtype, name="to_lowp")
     for i in range(num_layers):
         x = _block(x, batch_size, seq_len, embed, heads,
-                   "block%d" % i, causal=causal, attn_impl=attn_impl)
+                   "block%d" % i, causal=causal, attn_impl=attn_impl,
+                   fused_qkv=fused_qkv)
     x = sym.LayerNorm(x, axis=-1, name="ln_f")
     x = sym.Reshape(x, shape=(batch_size * seq_len, embed),
                     name="flatten_positions")

@@ -89,6 +89,10 @@ def lib() -> Optional[ctypes.CDLL]:
             cdll.tp_decode_resize_crop.argtypes = [
                 ctypes.c_char_p, LL, LL, LL, LL, LL, LL, LL,
                 ctypes.c_void_p]
+        if hasattr(cdll, "tp_transcode_jpeg"):
+            cdll.tp_transcode_jpeg.restype = LL
+            cdll.tp_transcode_jpeg.argtypes = [
+                ctypes.c_char_p, LL, LL, LL, ctypes.c_void_p, LL]
         _lib = cdll
         return _lib
 
@@ -207,3 +211,23 @@ def decoded_dims(buf: bytes, resize: int = 0):
             return resize, int(w * resize / h)
         return int(h * resize / w), resize
     return int(h), int(w)
+
+
+def transcode_jpeg(buf: bytes, resize: int = 0, quality: int = 95):
+    """Pack-time JPEG transcode (decode + bilinear shorter-side resize +
+    re-encode) in one GIL-free native call — the im2rec C++ stage
+    (reference ``tools/im2rec.cc``).  Returns the re-encoded bytes or
+    None (native decoder unavailable / not a decodable JPEG)."""
+    cdll = lib()
+    if cdll is None or not hasattr(cdll, "tp_transcode_jpeg"):
+        return None
+    dims = decoded_dims(buf, resize)
+    if dims is None:
+        return None
+    cap = dims[0] * dims[1] * 3 + (1 << 16)
+    out = np.empty(cap, np.uint8)
+    n = cdll.tp_transcode_jpeg(buf, len(buf), resize, quality,
+                               out.ctypes.data, cap)
+    if n < 0:
+        return None
+    return out[:n].tobytes()

@@ -247,5 +247,93 @@ long long tp_decode_resize_crop(const unsigned char* buf, long long len,
   return (static_cast<long long>(ih) << 32) | iw;
 }
 
+// Transcode for pack time (the native im2rec stage, reference
+// tools/im2rec.cc:1-302): decode a JPEG, bilinear-resize the SHORTER
+// side to `resize` (0 = keep), re-encode at `quality` into `out`
+// (capacity `cap`).  Returns bytes written, -1 decode/encode error,
+// -3 capacity too small.
+long long tp_transcode_jpeg(const unsigned char* buf, long long len,
+                            long long resize, long long quality,
+                            unsigned char* out, long long cap) {
+  jpeg_decompress_struct din;
+  TpJpegErr derr;
+  din.err = jpeg_std_error(&derr.mgr);
+  derr.mgr.error_exit = tp_jpeg_fail;
+  if (setjmp(derr.jb)) {
+    jpeg_destroy_decompress(&din);
+    return -1;
+  }
+  jpeg_create_decompress(&din);
+  jpeg_mem_src(&din, buf, static_cast<unsigned long>(len));
+  if (jpeg_read_header(&din, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&din);
+    return -1;
+  }
+  din.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&din);
+  const int sw = din.output_width, sh = din.output_height;
+  std::vector<uint8_t> raw(static_cast<size_t>(sw) * sh * 3);
+  while (din.output_scanline < din.output_height) {
+    uint8_t* row = raw.data() + static_cast<size_t>(
+        din.output_scanline) * sw * 3;
+    jpeg_read_scanlines(&din, &row, 1);
+  }
+  jpeg_finish_decompress(&din);
+  jpeg_destroy_decompress(&din);
+
+  const uint8_t* img = raw.data();
+  int ih = sh, iw = sw;
+  std::vector<uint8_t> resized;
+  if (resize > 0 && sh != resize && sw != resize) {
+    if (sh < sw) {
+      ih = static_cast<int>(resize);
+      iw = static_cast<int>(sw * static_cast<double>(resize) / sh);
+    } else {
+      iw = static_cast<int>(resize);
+      ih = static_cast<int>(sh * static_cast<double>(resize) / sw);
+    }
+    resized.resize(static_cast<size_t>(ih) * iw * 3);
+    tp_resize_bilinear(raw.data(), sh, sw, resized.data(), ih, iw);
+    img = resized.data();
+  }
+
+  jpeg_compress_struct cout_;
+  TpJpegErr eerr;
+  unsigned char* mem = nullptr;
+  unsigned long memlen = 0;
+  cout_.err = jpeg_std_error(&eerr.mgr);
+  eerr.mgr.error_exit = tp_jpeg_fail;
+  if (setjmp(eerr.jb)) {
+    jpeg_destroy_compress(&cout_);
+    if (mem != nullptr) free(mem);
+    return -1;
+  }
+  jpeg_create_compress(&cout_);
+  jpeg_mem_dest(&cout_, &mem, &memlen);
+  cout_.image_width = iw;
+  cout_.image_height = ih;
+  cout_.input_components = 3;
+  cout_.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cout_);
+  jpeg_set_quality(&cout_, static_cast<int>(quality), TRUE);
+  jpeg_start_compress(&cout_, TRUE);
+  while (cout_.next_scanline < cout_.image_height) {
+    const uint8_t* row = img + static_cast<size_t>(
+        cout_.next_scanline) * iw * 3;
+    uint8_t* rows[1] = {const_cast<uint8_t*>(row)};
+    jpeg_write_scanlines(&cout_, rows, 1);
+  }
+  jpeg_finish_compress(&cout_);
+  jpeg_destroy_compress(&cout_);
+  long long n = static_cast<long long>(memlen);
+  if (n > cap) {
+    free(mem);
+    return -3;
+  }
+  std::memcpy(out, mem, static_cast<size_t>(n));
+  free(mem);
+  return n;
+}
+
 }  // extern "C"
 #endif  // TP_WITH_JPEG

@@ -209,3 +209,50 @@ def test_uint8_iter_uses_native_decode(tmp_path):
     np.testing.assert_array_equal(ln, lp)
     # same geometry; pixels within interpolation-kernel distance
     assert np.abs(dn.astype(int) - dp.astype(int)).mean() < 8
+
+
+@pytest.mark.skipif(not HAVE_GXX, reason="no C++ toolchain")
+def test_native_transcode_jpeg(tmp_path):
+    """Pack-time transcode: resized shorter side, decodable output,
+    junk input falls back to None."""
+    cv2 = pytest.importorskip("cv2")
+    if native.lib() is None or not hasattr(native.lib(),
+                                           "tp_transcode_jpeg"):
+        pytest.skip("native decoder not built (no libjpeg)")
+    img = np.zeros((80, 120, 3), np.uint8)
+    img[..., 0] = np.outer(np.linspace(0, 255, 80), np.ones(120))
+    ok, enc = cv2.imencode(".jpg", img[:, :, ::-1])
+    out = native.transcode_jpeg(enc.tobytes(), resize=40, quality=90)
+    assert out is not None and out[:2] == b"\xff\xd8"
+    dec = cv2.imdecode(np.frombuffer(out, np.uint8), cv2.IMREAD_COLOR)
+    assert dec.shape == (40, 60, 3)
+    assert native.transcode_jpeg(b"junk") is None
+
+
+def test_im2rec_native_pack_readable(tmp_path):
+    """im2rec's native transcode path produces a pack the iterator
+    reads (end-to-end: jpg dir -> .rec -> decoded batches)."""
+    cv2 = pytest.importorskip("cv2")
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo_root, "tools"))
+    import im2rec
+
+    rng = np.random.RandomState(0)
+    img_root = str(tmp_path / "imgs")
+    os.makedirs(os.path.join(img_root, "c0"))
+    for i in range(4):
+        img = (rng.rand(60, 72, 3) * 255).astype(np.uint8)
+        cv2.imwrite(os.path.join(img_root, "c0", "i%d.jpg" % i), img)
+    prefix = str(tmp_path / "pack")
+    im2rec.main([prefix, img_root, "--resize", "48"])
+    starts = recordio.scan_record_starts(prefix + ".rec")
+    assert len(starts) == 4
+    rec = recordio.MXRecordIO(prefix + ".rec", "r")
+    from incubator_mxnet_tpu.image.image import _imdecode_np
+
+    hdr, payload = recordio.unpack(rec.read())
+    arr = _imdecode_np(payload)
+    assert min(arr.shape[:2]) == 48

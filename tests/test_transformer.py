@@ -369,3 +369,35 @@ def test_transformer_fused_head_learns_shift_task():
         if loss < 0.05:
             break
     assert loss < 0.05, "fused-head LM failed to learn: loss=%.3f" % loss
+
+
+def test_transformer_fused_qkv_matches_split():
+    """fused_qkv=True equals the split-projection net when the (3E, E)
+    weight is the concatenation of the split q/k/v weights."""
+    V, B, S, E = 11, 2, 8, 16
+    kw = dict(vocab_size=V, embed=E, heads=2, num_layers=1,
+              seq_len=S, batch_size=B)
+    net_s = mx.models.transformer_lm(**kw)
+    net_f = mx.models.transformer_lm(fused_qkv=True, **kw)
+    rng = np.random.RandomState(9)
+    shapes = dict(data=(B, S), softmax_label=(B, S))
+    ex_s = net_s.simple_bind(grad_req="null", **shapes)
+    ex_f = net_f.simple_bind(grad_req="null", **shapes)
+    assert "block0_qkv_weight" in ex_f.arg_dict
+    for n in ex_s.arg_dict:
+        if n in shapes:
+            continue
+        v = rng.uniform(-0.2, 0.2,
+                        ex_s.arg_dict[n].shape).astype(np.float32)
+        ex_s.arg_dict[n][:] = mx.nd.array(v)
+        if n in ex_f.arg_dict:
+            ex_f.arg_dict[n][:] = mx.nd.array(v)
+    qkv = np.concatenate([ex_s.arg_dict["block0_%s_weight" % p].asnumpy()
+                          for p in ("q", "k", "v")])
+    ex_f.arg_dict["block0_qkv_weight"][:] = mx.nd.array(qkv)
+    toks = rng.randint(0, V, (B, S)).astype(np.float32)
+    for ex in (ex_s, ex_f):
+        ex.arg_dict["data"][:] = mx.nd.array(toks)
+    np.testing.assert_allclose(
+        ex_f.forward(is_train=False)[0].asnumpy(),
+        ex_s.forward(is_train=False)[0].asnumpy(), rtol=1e-5, atol=1e-6)

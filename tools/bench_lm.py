@@ -77,9 +77,11 @@ def main():
         # largest head count with ~128-wide heads that divides embed
         heads = next(h for h in range(max(1, E // 128), 0, -1)
                      if E % h == 0)
+    fused_qkv = os.environ.get("TP_LM_FUSED_QKV") == "1"
     net = mx.models.transformer_lm(
         vocab_size=V, embed=E, heads=heads,
-        num_layers=L, seq_len=S, batch_size=B, dtype=dtype, head=head)
+        num_layers=L, seq_len=S, batch_size=B, dtype=dtype, head=head,
+        fused_qkv=fused_qkv)
     step = parallel.FusedTrainStep(
         net, {"data": (B, S)}, {"softmax_label": (B, S)},
         mesh=parallel.default_mesh(1), optimizer="adam",

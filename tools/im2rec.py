@@ -105,6 +105,22 @@ def image_encode(args, i, item, q_out):
         s = recordio.pack(header, img)
         q_out.append((i, s, item))
         return
+    # native fast path: plain resize-and-repack of a JPEG runs as one
+    # GIL-free C transcode (decode + bilinear resize + encode) — the
+    # reference's C++ im2rec stage (tools/im2rec.cc).  Center-crop,
+    # non-JPEG sources, and non-jpg output keep the cv2 path.
+    if not args.center_crop and args.color == 1 \
+            and args.encoding in (".jpg", ".jpeg") \
+            and fullpath.lower().endswith((".jpg", ".jpeg")):
+        from incubator_mxnet_tpu import native
+
+        with open(fullpath, "rb") as fin:
+            raw = fin.read()
+        enc = native.transcode_jpeg(raw, resize=args.resize or 0,
+                                    quality=args.quality)
+        if enc is not None:
+            q_out.append((i, recordio.pack(header, enc), item))
+            return
     import cv2
 
     img = cv2.imread(fullpath, args.color)
