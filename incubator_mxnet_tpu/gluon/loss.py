@@ -5,7 +5,7 @@ from .block import HybridBlock
 
 __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
-           "KLDivLoss", "HuberLoss", "HingeLoss"]
+           "KLDivLoss", "HuberLoss", "HingeLoss", "FusedSoftmaxCEHead"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -139,3 +139,33 @@ class HingeLoss(Loss):
         loss = F.relu(self._margin - pred * label)
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class FusedSoftmaxCEHead(Loss):
+    """Projection + softmax + cross-entropy as ONE chunked op — the
+    gluon face of ``_contrib_SoftmaxXentHead`` (ops/nn.py): the
+    (N, vocab) logits never materialize, so large-vocab LM heads train
+    within memory (PERF.md §12).  Unlike ``SoftmaxCrossEntropyLoss``
+    this block OWNS the output projection weight; call it on features
+    (N, in_units) + sparse labels (N,) and it returns the mean loss.
+
+    Not in the reference (its gluon predates fused heads); provided for
+    parity between the symbolic (``models.transformer_lm(head='fused')``)
+    and gluon frontends.
+    """
+
+    def __init__(self, vocab_size, in_units, weight_initializer=None,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._vocab = vocab_size
+        with self.name_scope():
+            self.head_weight = self.params.get(
+                "weight", shape=(vocab_size, in_units),
+                init=weight_initializer)
+
+    def hybrid_forward(self, F, pred, label, head_weight=None,
+                       sample_weight=None):
+        loss = F.SoftmaxXentHead(pred, head_weight, label,
+                                 num_hidden=self._vocab)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss)
