@@ -54,7 +54,8 @@ class FusedTrainStep:
                  initializer=None, dtype=None, seed: int = 0,
                  param_partition: Optional[Dict[str, Any]] = None,
                  flat_optimizer: bool = False, remat=None,
-                 grad_accum: Optional[int] = None):
+                 grad_accum: Optional[int] = None,
+                 opt_state_dtype=None):
         import jax
         import jax.numpy as jnp
 
@@ -75,6 +76,12 @@ class FusedTrainStep:
         self._accum = int(grad_accum)
         if self._accum < 1:
             raise MXNetError("grad_accum must be >= 1")
+        # optimizer-state storage dtype (e.g. "bfloat16"): halves the
+        # m/v HBM streams of the update — the adam floor lever measured
+        # in PERF.md §21.  Update math stays f32 (states upcast in the
+        # step, downcast on store); opt-in, None = f32 masters.
+        self._state_dtype = dtype_np(opt_state_dtype) \
+            if opt_state_dtype else None
         self.mesh = mesh if mesh is not None else default_mesh()
         label_shapes = label_shapes or {}
         shapes = dict(data_shapes)
@@ -132,6 +139,9 @@ class FusedTrainStep:
             raise MXNetError("flat_optimizer is incompatible with "
                              "param_partition (no per-tensor sharding on "
                              "a flat buffer)")
+        if flat_optimizer and opt_state_dtype:
+            raise MXNetError("flat_optimizer is incompatible with "
+                             "opt_state_dtype")
         self._flat_opt = bool(flat_optimizer)
         self.num_update = 0
 
@@ -169,8 +179,13 @@ class FusedTrainStep:
         self.aux = {n: jax.device_put(
             jnp.ones(s) if n.endswith(("var",)) else jnp.zeros(s), rep)
             for n, s in zip(aux_names, aux_shapes)}
+        def state_like(p):
+            z = jnp.zeros_like(p) if self._state_dtype is None \
+                else jnp.zeros(p.shape, self._state_dtype)
+            return z
+
         self.opt_states = {
-            n: tuple(jax.device_put(jnp.zeros_like(self.params[n]),
+            n: tuple(jax.device_put(state_like(self.params[n]),
                                     self._param_sharding[n])
                      for _ in range(self._n_states))
             for n in self.param_names}
@@ -283,10 +298,15 @@ class FusedTrainStep:
             else:
                 for name, w in params.items():
                     g = grads[name].astype(w.dtype)
-                    res, _ = opt_op.apply([w, g] + list(opt_states[name]),
+                    # low-precision stored states: upcast for the
+                    # update math, downcast on store
+                    sts = [s.astype(w.dtype) for s in opt_states[name]]
+                    res, _ = opt_op.apply([w, g] + sts,
                                           attrs, OpContext(is_train=True))
                     new_params[name] = res[0]
-                    new_states[name] = tuple(res[1:1 + n_states])
+                    new_states[name] = tuple(
+                        r.astype(s.dtype) for r, s in
+                        zip(res[1:1 + n_states], opt_states[name]))
             return new_params, new_states, new_aux, outs
 
         dp = lambda ndim: data_parallel_spec(self.mesh, ndim)  # noqa: E731

@@ -86,6 +86,7 @@ def main():
         net, {"data": (B, S)}, {"softmax_label": (B, S)},
         mesh=parallel.default_mesh(1), optimizer="adam",
         optimizer_params={"learning_rate": 1e-3},
+        opt_state_dtype=os.environ.get("TP_LM_OPT_DTYPE") or None,
         initializer=mx.initializer.Xavier())
 
     rng = np.random.RandomState(0)
