@@ -206,7 +206,7 @@ def decoded_dims(buf: bytes, resize: int = 0):
         i += 2 + seglen
     if not h or not w:
         return None
-    if resize > 0 and h != resize and w != resize:
+    if resize > 0 and min(h, w) != resize:
         if h < w:
             return resize, int(w * resize / h)
         return int(h * resize / w), resize

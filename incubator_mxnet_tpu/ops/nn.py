@@ -774,16 +774,14 @@ def _layer_norm(ins, attrs, ctx):
     eps = parse_float(attrs.get("eps", 1e-5))
     axis = parse_int(attrs.get("axis"), -1)
     x32 = data.astype(jnp.float32)
-    # single-pass statistics: sum and sum-of-squares as ONE multi-
-    # output reduce (one read of the activation; jnp.var's mean-then-
-    # deviation form reads it twice).  E[x²]−mean² in f32 is safe at
-    # LN's normalized-feature magnitudes; max(·, 0) guards the
-    # cancellation edge (same design as BatchNorm above).
-    n = data.shape[axis]
-    s = jnp.sum(x32, axis=axis, keepdims=True)
-    s2 = jnp.sum(jnp.square(x32), axis=axis, keepdims=True)
-    mean = s / n
-    var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
+    # two-pass statistics (jnp.var = mean-then-deviation) on purpose:
+    # the one-pass E[x²]−mean² form catastrophically cancels for rows
+    # with |mean| ≫ std (caught in round-4 review), and the shifted
+    # one-pass variant (shift = row's first element, BatchNorm-style)
+    # measured SLOWER than two-pass on the LM flagship — the gather +
+    # broadcast blocks XLA's reduce fusion (37.0k vs 37.8k tok/s).
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
     shp = [1] * data.ndim
     shp[axis] = data.shape[axis]
     y = (x32 - mean) * jax.lax.rsqrt(var + eps) \

@@ -213,7 +213,7 @@ long long tp_decode_resize_crop(const unsigned char* buf, long long len,
   const uint8_t* img = raw.data();
   int ih = sh, iw = sw;
   std::vector<uint8_t> resized;
-  if (resize > 0 && (sh != resize && sw != resize)) {
+  if (resize > 0 && (sh < sw ? sh : sw) != resize) {
     if (sh < sw) {
       ih = static_cast<int>(resize);
       iw = static_cast<int>(sw * static_cast<double>(resize) / sh);
@@ -284,7 +284,7 @@ long long tp_transcode_jpeg(const unsigned char* buf, long long len,
   const uint8_t* img = raw.data();
   int ih = sh, iw = sw;
   std::vector<uint8_t> resized;
-  if (resize > 0 && sh != resize && sw != resize) {
+  if (resize > 0 && (sh < sw ? sh : sw) != resize) {
     if (sh < sw) {
       ih = static_cast<int>(resize);
       iw = static_cast<int>(sw * static_cast<double>(resize) / sh);

@@ -256,3 +256,24 @@ def test_im2rec_native_pack_readable(tmp_path):
     hdr, payload = recordio.unpack(rec.read())
     arr = _imdecode_np(payload)
     assert min(arr.shape[:2]) == 48
+
+
+@pytest.mark.skipif(not HAVE_GXX, reason="no C++ toolchain")
+def test_decode_resize_when_one_side_already_matches():
+    """Shorter-side resize must trigger when only the LONGER side
+    equals `resize` (regression: `h != r and w != r` skipped it)."""
+    cv2 = pytest.importorskip("cv2")
+    if native.lib() is None or not hasattr(native.lib(),
+                                           "tp_decode_resize_crop"):
+        pytest.skip("native decoder not built (no libjpeg)")
+    img = np.zeros((256, 170, 3), np.uint8)
+    ok, enc = cv2.imencode(".jpg", img)
+    buf = enc.tobytes()
+    # shorter side is 170 -> resize=256 must scale to (385, 256)
+    assert native.decoded_dims(buf, resize=256) == (385, 256)
+    out = native.decode_resize_crop(buf, 256, 256, resize=256)
+    assert out is not None and out.shape == (256, 256, 3)
+    trans = native.transcode_jpeg(buf, resize=256)
+    dec = cv2.imdecode(np.frombuffer(trans, np.uint8),
+                       cv2.IMREAD_COLOR)
+    assert dec.shape[:2] == (385, 256)

@@ -934,3 +934,21 @@ def test_batchnorm_ghost_sample_stats():
     # and differs from full-batch stats when halves differ
     assert np.abs(run({"ghost_sample": "2"}, x)
                   - run({}, x)).max() > 1e-4
+
+
+def test_layernorm_large_offset_variance():
+    """Single-pass LN statistics survive a large common offset (the
+    E[x²]−mean² cancellation case): mean≈300, std≈0.05 must normalize
+    to unit variance, matching the two-pass oracle."""
+    from incubator_mxnet_tpu.ops.registry import OpContext, get_op
+
+    rng = np.random.RandomState(0)
+    x = (300.0 + 0.05 * rng.randn(4, 64)).astype(np.float32)
+    op = get_op("LayerNorm")
+    (y,), _ = op.apply([jnp.asarray(x), jnp.ones(64), jnp.zeros(64)],
+                       {"axis": "-1"}, OpContext(is_train=True))
+    y = np.asarray(y)
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(y, ref, rtol=2e-2, atol=2e-2)
+    assert 0.9 < y.std() < 1.1
