@@ -34,19 +34,54 @@ def main():
     ap.add_argument("--num-batches", type=int, default=8)
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--shift", type=int, default=1)
+    ap.add_argument("--fused-head", action="store_true",
+                    help="chunked softmax-xent head: the (B*S, V) "
+                         "logits never materialize — required for "
+                         "large-vocab training (PERF.md §12); trains "
+                         "through FusedTrainStep and reports loss "
+                         "instead of accuracy")
+    ap.add_argument("--remat", default=None,
+                    help="recompute policy: 'mirror' or an int K "
+                         "(TP_REMAT_SEGMENTS parity)")
+    ap.add_argument("--grad-accum", type=int, default=None)
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     V, B, S = args.vocab_size, args.batch_size, args.seq_len
     net = mx.models.transformer_lm(
         vocab_size=V, embed=args.embed, heads=args.heads,
-        num_layers=args.num_layers, seq_len=S, batch_size=B)
+        num_layers=args.num_layers, seq_len=S, batch_size=B,
+        head="fused" if args.fused_head else "softmax")
 
     rng = np.random.RandomState(0)
     data = rng.randint(0, V, (args.num_batches, B, S)).astype(np.float32)
     labels = (data + args.shift) % V
 
     mx.random.seed(0)
+    if args.fused_head:
+        # the flagship configuration (tools/bench_lm.py): one fused
+        # fwd+bwd+adam program, optional remat / grad accumulation
+        from incubator_mxnet_tpu import parallel
+
+        remat = args.remat
+        if remat is not None and remat != "mirror":
+            remat = int(remat)
+        step = parallel.FusedTrainStep(
+            net, {"data": (B, S)}, {"softmax_label": (B, S)},
+            mesh=parallel.default_mesh(1), optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.initializer.Xavier(), remat=remat,
+            grad_accum=args.grad_accum)
+        for epoch in range(args.num_epochs):
+            loss = 0.0
+            for b in range(args.num_batches):
+                outs = step({"data": data[b],
+                             "softmax_label": labels[b]})
+                loss = float(np.asarray(outs[0]).mean())
+            logging.info("Epoch[%d] Train-loss=%.4f", epoch, loss)
+        print("done")
+        return 0
+
     mod = mx.mod.Module(net, context=mx.cpu())
     mod.bind(data_shapes=[("data", (B, S))],
              label_shapes=[("softmax_label", (B, S))])
