@@ -236,6 +236,20 @@ class PipelineTrainStep:
             raise ValueError("num_layers %d must divide over %d pipeline "
                              "stages" % (num_layers, npp))
         self.axis_name = axis_name
+        # every OTHER mesh axis is data parallelism: the per-microbatch
+        # batch shards over it (dp x pp composition); grads of the
+        # pp-sharded block stacks and replicated embed/head params are
+        # psummed over it by the shard_map transpose
+        self._data_axes = tuple(a for a in mesh.axis_names
+                                if a != axis_name)
+        ndp = 1
+        for a in self._data_axes:
+            ndp *= mesh.shape[a]
+        if (batch_size // num_microbatches) % max(ndp, 1):
+            raise ValueError(
+                "microbatch size %d must shard over %d data-parallel "
+                "devices" % (batch_size // num_microbatches, ndp))
+        self._ndp = ndp
         self.cfg = dict(vocab_size=vocab_size, embed=embed, heads=heads,
                         num_layers=num_layers, seq_len=seq_len,
                         batch_size=batch_size,
@@ -326,7 +340,8 @@ class PipelineTrainStep:
         cfg = self.cfg
         axis = self.axis_name
         M = cfg["num_microbatches"]
-        b = cfg["batch_size"] // M
+        b = cfg["batch_size"] // M // self._ndp  # per-device microbatch
+        data_axes = self._data_axes
         S, E, V = cfg["seq_len"], cfg["embed"], cfg["vocab_size"]
         heads, causal = cfg["heads"], cfg["causal"]
         attn_impl = cfg["attn_impl"]
@@ -360,8 +375,10 @@ class PipelineTrainStep:
             state = jnp.zeros((b, S, E), act_dtype)
             outs = jnp.zeros((M, b, S, E), act_dtype)
             if hasattr(lax, "pcast"):
-                state = lax.pcast(state, (axis,), to="varying")
-                outs = lax.pcast(outs, (axis,), to="varying")
+                state = lax.pcast(state, (axis,) + data_axes,
+                                  to="varying")
+                outs = lax.pcast(outs, (axis,) + data_axes,
+                                 to="varying")
             perm = [(i, i + 1) for i in range(L - 1)]
 
             def tick(carry, t):
@@ -388,15 +405,18 @@ class PipelineTrainStep:
             loss_vec = sxh(z, params["lm_head_weight"],
                            labels.reshape(-1).astype(jnp.float32))
             loss = jnp.sum(jnp.where(idx == L - 1, loss_vec, 0.0))
-            return lax.psum(loss, axis)
+            return lax.psum(loss, (axis,) + data_axes)
 
         P = jax.sharding.PartitionSpec
         spec_of = {n: (P(axis) if n in block_leaves else P())
                    for n in self.params}
+        # microbatch tokens/labels (M, b, S): batch axis shards over
+        # the data axes (if any); the M and S axes stay unsharded
+        data_spec = P(None, data_axes if data_axes else None)
         shard_map = shard_map_fn()
         smap_kw = dict(mesh=self.mesh,
                        in_specs=({n: spec_of[n] for n in self.params},
-                                 P(), P()),
+                                 data_spec, data_spec),
                        out_specs=P())
         # replication of the replicated-param cotangents cannot be
         # statically inferred through the transpose of the tick loop —
@@ -434,10 +454,10 @@ class PipelineTrainStep:
         param_sh = self._shardings
         state_sh = {n: tuple(param_sh[n] for _ in range(n_states))
                     for n in self.params}
-        rep = jax.sharding.NamedSharding(self.mesh, P())
+        data_sh = jax.sharding.NamedSharding(self.mesh, data_spec)
         return jax.jit(step,
                        in_shardings=(param_sh, state_sh, None, None,
-                                     rep, rep),
+                                     data_sh, data_sh),
                        out_shardings=(param_sh, state_sh, None),
                        donate_argnums=(0, 1))
 

@@ -96,6 +96,15 @@ def test_train_transformer_lm():
     assert "Train-accuracy" in out and "done" in out
 
 
+def test_train_transformer_lm_fused_head():
+    """The flagship configuration: fused chunked softmax-xent head
+    through FusedTrainStep, with segment remat."""
+    out = _run("train_transformer_lm.py", "--num-epochs", "2",
+               "--seq-len", "16", "--num-batches", "4",
+               "--vocab-size", "16", "--fused-head", "--remat", "2")
+    assert "Train-loss" in out and "done" in out
+
+
 def test_train_dcgan():
     out = _run("train_dcgan.py", "--num-epochs", "1",
                "--num-batches", "2", "--size", "32")

@@ -278,3 +278,41 @@ def test_moe_dispatch_is_capacity_bound():
     dense = "%d,%d,%d" % (E, t_local, d)
     assert dense not in str(jaxpr), \
         "dense (E, T, d) dispatch intermediate found"
+
+
+def test_pipeline_train_step_composes_with_dp():
+    """dp×pp on one mesh: batch shards over dp while stages pipeline
+    over pp — same loss curve as the single-device step."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.parallel.pipeline import PipelineTrainStep
+
+    V, E, H, L, S, B, M = 16, 16, 2, 4, 8, 8, 2
+    rng = np.random.RandomState(4)
+    net = mx.models.transformer_lm(vocab_size=V, embed=E, heads=H,
+                                   num_layers=L, seq_len=S,
+                                   batch_size=B, head="fused")
+    mx.random.seed(5)
+    fused = parallel.FusedTrainStep(
+        net, {"data": (B, S)}, {"softmax_label": (B, S)},
+        mesh=parallel.default_mesh(1), optimizer="adam",
+        optimizer_params={"learning_rate": 3e-3},
+        initializer=mx.initializer.Xavier())
+
+    mesh = build_mesh({"dp": 2, "pp": 4})
+    pp = PipelineTrainStep(mesh, vocab_size=V, embed=E, heads=H,
+                           num_layers=L, seq_len=S, batch_size=B,
+                           num_microbatches=M, optimizer="adam",
+                           optimizer_params={"learning_rate": 3e-3})
+    arg_params, _ = fused.get_params()
+    pp.set_params(arg_params)
+
+    toks = rng.randint(0, V, (4, B, S)).astype(np.float32)
+    labs = (toks + 1) % V
+    for i in range(4):
+        batch = {"data": toks[i], "softmax_label": labs[i]}
+        outs = fused(batch)
+        fused_loss = float(np.asarray(outs[0]).mean())
+        pp_loss = pp(batch)
+        np.testing.assert_allclose(pp_loss, fused_loss, rtol=2e-4,
+                                   atol=2e-5, err_msg="step %d" % i)
