@@ -24,6 +24,7 @@ from .sequence import (attention, ring_attention, ulysses_attention,
                        sequence_parallel_attention)
 from .pipeline import pipeline_apply, pipeline_parallel_apply
 from .moe import moe_ffn, expert_parallel_moe
+from .vocab_parallel import vocab_parallel_softmax_xent
 from .checkpoint import save_sharded, restore_sharded
 
 __all__ = ["build_mesh", "default_mesh", "data_parallel_spec",

@@ -1,0 +1,86 @@
+"""Vocab-parallel (tensor-parallel) fused softmax-cross-entropy head.
+
+The multi-chip form of ``_contrib_SoftmaxXentHead`` (ops/nn.py): the
+vocabulary projection shards over a mesh axis, each device computes
+logits only for ITS vocab slice, and the softmax combines with three
+tiny collectives (pmax for the global row max, psum for the normalizer,
+pmax for the target logit) — the Megatron-style vocab-parallel loss,
+here with the same loss-head convention as ``SoftmaxOutput``/the fused
+head: backward ignores the incoming cotangent and emits the
+cross-entropy gradient.
+
+Per-device memory is O(N · V/n); dX psums over the axis, dW stays
+local to each shard.  Call inside shard_map with ``w_shard`` =
+(V/n, E) local slice and x/label replicated on the axis.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["vocab_parallel_softmax_xent"]
+
+
+def vocab_parallel_softmax_xent(x, w_shard, label, axis_name: str = "tp",
+                                grad_scale: float = 1.0):
+    """loss[i] = logsumexp_global(x·Wᵀ) − logit[y[i]] over a
+    vocab-sharded projection; returns (N,) f32 per-position loss."""
+    return _vp_sxh(axis_name, float(grad_scale))(x, w_shard, label)
+
+
+@functools.lru_cache(maxsize=None)
+def _vp_sxh(axis_name, grad_scale):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def _local_logits(x, w_shard):
+        return jnp.matmul(x, w_shard.astype(x.dtype).T,
+                          preferred_element_type=jnp.float32)
+
+    def _fwd(x, w_shard, label):
+        n_shard = w_shard.shape[0]
+        idx = lax.axis_index(axis_name)
+        off = idx * n_shard
+        logits = _local_logits(x, w_shard)            # (N, V/n) f32
+        m = lax.pmax(jnp.max(logits, axis=-1), axis_name)
+        se = lax.psum(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1),
+                      axis_name)
+        lse = m + jnp.log(se)
+        lab = label.reshape(-1).astype(jnp.int32)
+        mine = (lab >= off) & (lab < off + n_shard)
+        safe = jnp.clip(lab - off, 0, n_shard - 1)
+        tgt_local = jnp.where(
+            mine, jnp.take_along_axis(logits, safe[:, None],
+                                      axis=-1)[:, 0], -jnp.inf)
+        tgt = lax.pmax(tgt_local, axis_name)
+        return lse - tgt, lse
+
+    @jax.custom_vjp
+    def f(x, w_shard, label):
+        return _fwd(x, w_shard, label)[0]
+
+    def f_fwd(x, w_shard, label):
+        loss, lse = _fwd(x, w_shard, label)
+        return loss, (x, w_shard, label, lse)
+
+    def f_bwd(res, g):
+        # loss-head convention: incoming cotangent ignored
+        x, w_shard, label, lse = res
+        n_shard = w_shard.shape[0]
+        idx = lax.axis_index(axis_name)
+        off = idx * n_shard
+        logits = _local_logits(x, w_shard)
+        lab = label.reshape(-1).astype(jnp.int32)
+        mine = (lab >= off) & (lab < off + n_shard)
+        safe = jnp.clip(lab - off, 0, n_shard - 1)
+        d = jnp.exp(logits - lse[:, None])
+        d = d - jax.nn.one_hot(safe, n_shard, dtype=d.dtype) \
+            * mine[:, None].astype(d.dtype)
+        d = (d * grad_scale).astype(x.dtype)
+        wc = w_shard.astype(x.dtype)
+        dx = lax.psum(jnp.matmul(d, wc), axis_name)
+        dw = jnp.matmul(d.T, x, preferred_element_type=jnp.float32)
+        return dx, dw.astype(w_shard.dtype), jnp.zeros_like(label)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
