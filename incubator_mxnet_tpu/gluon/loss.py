@@ -1,6 +1,7 @@
 """Gluon losses (``python/mxnet/gluon/loss.py``, 297 LoC)."""
 from __future__ import annotations
 
+from ..base import MXNetError
 from .block import HybridBlock
 
 __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
@@ -152,20 +153,39 @@ class FusedSoftmaxCEHead(Loss):
     Not in the reference (its gluon predates fused heads); provided for
     parity between the symbolic (``models.transformer_lm(head='fused')``)
     and gluon frontends.
+
+    Gradient convention: the op's custom VJP emits the analytic
+    softmax-xent gradient scaled only by its ``grad_scale`` /
+    ``normalization`` attrs — it ignores the incoming cotangent, so a
+    ``weight`` or ``sample_weight`` here would rescale the reported
+    loss value but NOT the gradients (unlike every other gluon Loss).
+    Both are therefore rejected; fold a global weight into
+    ``grad_scale`` on the op instead.
     """
 
     def __init__(self, vocab_size, in_units, weight_initializer=None,
                  weight=None, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
+        if weight is not None:
+            raise MXNetError(
+                "FusedSoftmaxCEHead does not support `weight`: the fused "
+                "op's VJP ignores the incoming cotangent, so a weight "
+                "would scale the loss value but not the gradients. Use "
+                "the op's grad_scale attr instead.")
+        super().__init__(None, batch_axis, **kwargs)
         self._vocab = vocab_size
         with self.name_scope():
             self.head_weight = self.params.get(
                 "weight", shape=(vocab_size, in_units),
                 init=weight_initializer)
 
-    def hybrid_forward(self, F, pred, label, head_weight=None,
-                       sample_weight=None):
+    def hybrid_forward(self, F, pred, label, sample_weight=None,
+                       head_weight=None):
+        if sample_weight is not None:
+            raise MXNetError(
+                "FusedSoftmaxCEHead does not support `sample_weight`: "
+                "the fused op's VJP ignores the incoming cotangent, so "
+                "per-sample weights would affect only the reported loss "
+                "value, never the gradients.")
         loss = F.SoftmaxXentHead(pred, head_weight, label,
                                  num_hidden=self._vocab)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return F.mean(loss)

@@ -195,6 +195,13 @@ def decoded_dims(buf: bytes, resize: int = 0):
     while i + 9 < n:
         if buf[i] != 0xFF:
             return None
+        # JPEG allows any number of 0xFF fill bytes before a marker
+        # code (ITU T.81 §B.1.1.2) — consume them or valid padded
+        # files would silently lose the native fast path
+        while i + 9 < n and buf[i + 1] == 0xFF:
+            i += 1
+        if i + 9 >= n:
+            return None
         marker = buf[i + 1]
         if marker in (0xD8, 0x01) or 0xD0 <= marker <= 0xD7:
             i += 2

@@ -234,6 +234,12 @@ def save(fname: str, data) -> None:
         f.write(struct.pack("<Q", len(arrays)))
         for arr in arrays:
             a = np.ascontiguousarray(arr.asnumpy())
+            if a.ndim == 0:
+                # ndim==0 means "empty NDArray" to the reference loader
+                # (ndarray.cc early-returns without consuming Context/
+                # type/data) — a scalar written as ndim=0 + payload
+                # would desync every later array; persist as (1,)
+                a = a.reshape(1)
             if a.dtype.name == "bfloat16" or a.dtype.name not in _TYPE_FLAGS:
                 a = a.astype(np.float32)
             f.write(struct.pack("<I", _NDARRAY_V1_MAGIC))

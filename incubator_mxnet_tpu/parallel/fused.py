@@ -111,6 +111,27 @@ class FusedTrainStep:
                         "grad_accum requires batch-major inputs; %r has "
                         "leading dim %s != global batch %d"
                         % (n, s[0] if s else None, self.global_batch))
+            # loss heads normalize per MICROBATCH: any op with
+            # normalization='batch'/'valid' (SoftmaxOutput, MakeLoss,
+            # SoftmaxXentHead) divides its backward by the microbatch
+            # count, so the k summed grads come out k-fold larger than
+            # the same global batch un-accumulated (only 'null' is
+            # accumulation-invariant) — reject rather than silently
+            # train at k× the intended lr
+            for node in symbol.topo_nodes():
+                if node.op is None:
+                    continue
+                norm = (node.attrs or {}).get("normalization", "null")
+                if norm != "null":
+                    raise MXNetError(
+                        "grad_accum=%d with op %s using "
+                        "normalization=%r: the loss divides by the "
+                        "microbatch (not global-batch) count, so "
+                        "accumulated grads would be %d-fold too "
+                        "large. Use normalization='null' with an "
+                        "explicit grad_scale."
+                        % (self._accum, node.op.name, norm,
+                           self._accum))
 
         # ---- optimizer resolution ---------------------------------------
         opt_params = dict(optimizer_params or {})

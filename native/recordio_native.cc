@@ -186,6 +186,12 @@ long long tp_decode_resize_crop(const unsigned char* buf, long long len,
                                 unsigned char* out) {
   jpeg_decompress_struct cinfo;
   TpJpegErr err;
+  // pixel buffers live OUTSIDE the setjmp region: a longjmp from the
+  // scanline loop across non-trivially-destructible locals is UB and
+  // leaks the allocations; declared here they survive the jump and
+  // destruct normally on return
+  std::vector<uint8_t> raw;
+  std::vector<uint8_t> resized;
   cinfo.err = jpeg_std_error(&err.mgr);
   err.mgr.error_exit = tp_jpeg_fail;
   if (setjmp(err.jb)) {
@@ -201,7 +207,7 @@ long long tp_decode_resize_crop(const unsigned char* buf, long long len,
   cinfo.out_color_space = JCS_RGB;
   jpeg_start_decompress(&cinfo);
   const int sw = cinfo.output_width, sh = cinfo.output_height;
-  std::vector<uint8_t> raw(static_cast<size_t>(sw) * sh * 3);
+  raw.resize(static_cast<size_t>(sw) * sh * 3);
   while (cinfo.output_scanline < cinfo.output_height) {
     uint8_t* row = raw.data() + static_cast<size_t>(
         cinfo.output_scanline) * sw * 3;
@@ -212,7 +218,6 @@ long long tp_decode_resize_crop(const unsigned char* buf, long long len,
 
   const uint8_t* img = raw.data();
   int ih = sh, iw = sw;
-  std::vector<uint8_t> resized;
   if (resize > 0 && (sh < sw ? sh : sw) != resize) {
     if (sh < sw) {
       ih = static_cast<int>(resize);
@@ -257,6 +262,10 @@ long long tp_transcode_jpeg(const unsigned char* buf, long long len,
                             unsigned char* out, long long cap) {
   jpeg_decompress_struct din;
   TpJpegErr derr;
+  // see tp_decode_resize_crop: buffers outside the setjmp region so a
+  // decode-error longjmp cannot skip their destructors
+  std::vector<uint8_t> raw;
+  std::vector<uint8_t> resized;
   din.err = jpeg_std_error(&derr.mgr);
   derr.mgr.error_exit = tp_jpeg_fail;
   if (setjmp(derr.jb)) {
@@ -272,7 +281,7 @@ long long tp_transcode_jpeg(const unsigned char* buf, long long len,
   din.out_color_space = JCS_RGB;
   jpeg_start_decompress(&din);
   const int sw = din.output_width, sh = din.output_height;
-  std::vector<uint8_t> raw(static_cast<size_t>(sw) * sh * 3);
+  raw.resize(static_cast<size_t>(sw) * sh * 3);
   while (din.output_scanline < din.output_height) {
     uint8_t* row = raw.data() + static_cast<size_t>(
         din.output_scanline) * sw * 3;
@@ -283,7 +292,6 @@ long long tp_transcode_jpeg(const unsigned char* buf, long long len,
 
   const uint8_t* img = raw.data();
   int ih = sh, iw = sw;
-  std::vector<uint8_t> resized;
   if (resize > 0 && (sh < sw ? sh : sw) != resize) {
     if (sh < sw) {
       ih = static_cast<int>(resize);

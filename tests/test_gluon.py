@@ -354,3 +354,26 @@ def test_fused_softmax_ce_head_trains():
         if first is None:
             first = float(loss.asnumpy())
     assert float(loss.asnumpy()) < 0.5 * first
+
+
+def test_fused_softmax_ce_head_rejects_weighting():
+    """weight/sample_weight would rescale only the reported loss value
+    (the fused op's VJP ignores the incoming cotangent), silently NOT
+    the gradients — both are rejected up front."""
+    import numpy as np
+
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.base import MXNetError
+    import incubator_mxnet_tpu as mx
+
+    with pytest.raises(MXNetError, match="weight"):
+        gluon.loss.FusedSoftmaxCEHead(vocab_size=7, in_units=8,
+                                      weight=0.5)
+
+    head = gluon.loss.FusedSoftmaxCEHead(vocab_size=7, in_units=8)
+    head.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 8)
+                    .astype(np.float32))
+    lab = mx.nd.array(np.zeros(4, np.float32))
+    with pytest.raises(MXNetError, match="sample_weight"):
+        head(x, lab, mx.nd.ones((4,)))

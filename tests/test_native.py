@@ -277,3 +277,24 @@ def test_decode_resize_when_one_side_already_matches():
     dec = cv2.imdecode(np.frombuffer(trans, np.uint8),
                        cv2.IMREAD_COLOR)
     assert dec.shape[:2] == (385, 256)
+
+
+def test_decoded_dims_skips_marker_fill_bytes(tmp_path):
+    """JPEG permits runs of 0xFF fill bytes before a marker code (ITU
+    T.81 B.1.1.2); the header scan must consume them or valid padded
+    files silently lose the native fast path."""
+    cv2 = pytest.importorskip("cv2")
+    if native.lib() is None or not hasattr(native.lib(),
+                                           "tp_decode_resize_crop"):
+        pytest.skip("native decoder not built (no libjpeg)")
+    img = np.full((40, 60, 3), 128, np.uint8)
+    ok, enc = cv2.imencode(".jpg", img)
+    buf = enc.tobytes()
+    # pad: extra 0xFF fill bytes after SOI, before the first marker
+    padded = buf[:2] + b"\xff\xff" + buf[2:]
+    assert native.decoded_dims(buf) == (40, 60)
+    assert native.decoded_dims(padded) == (40, 60)
+    # libjpeg itself accepts the padded stream, so the one-shot decode
+    # keeps working end to end
+    out = native.decode_resize_crop(padded, 40, 60)
+    assert out is not None and out.shape == (40, 60, 3)

@@ -243,3 +243,19 @@ def test_wait_and_engine():
     b.wait_to_read()
     mx.nd.waitall()
     assert b.shape == (100, 100)
+
+
+def test_save_load_scalar_no_desync(tmp_path):
+    """A 0-d NDArray persists as shape (1,): writing ndim=0 followed by
+    Context/type/payload would desync the stream on load (the ndim==0
+    branch early-returns per the reference's empty-NDArray semantics,
+    ``ndarray.cc:693``) and corrupt every subsequent array."""
+    fname = str(tmp_path / "scalar.params")
+    d = {"s": mx.nd.array(np.asarray(3.5, np.float32)),
+         "w": mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))}
+    assert d["s"].shape == ()
+    mx.nd.save(fname, d)
+    back = mx.nd.load(fname)
+    assert back["s"].shape == (1,)
+    assert float(back["s"].asnumpy()[0]) == 3.5
+    np.testing.assert_allclose(back["w"].asnumpy(), d["w"].asnumpy())

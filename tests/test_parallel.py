@@ -333,3 +333,30 @@ def test_opt_state_dtype_bf16_converges():
     # both train to a similar loss (bf16 states are a rounding, not a
     # different algorithm)
     assert runs["bfloat16"] < 1.2 * runs[None] + 0.05, runs
+
+
+def test_grad_accum_rejects_non_null_head_normalization():
+    """A fused softmax-xent head with normalization='batch'/'valid'
+    divides by the MICROBATCH count, so accumulated grads would come
+    out k-fold too large — FusedTrainStep refuses the combination."""
+    from incubator_mxnet_tpu.base import MXNetError
+
+    def lm(norm):
+        x = mx.sym.Variable("data")
+        lab = mx.sym.Variable("label")
+        w = mx.sym.Variable("head_weight")
+        return mx.sym.SoftmaxXentHead(x, w, lab, num_hidden=5,
+                                      normalization=norm,
+                                      name="softmax")
+
+    with pytest.raises(MXNetError, match="normalization"):
+        parallel.FusedTrainStep(lm("batch"), {"data": (8, 4)},
+                                {"label": (8,)},
+                                mesh=parallel.default_mesh(1),
+                                grad_accum=2)
+    # the accumulation-invariant default is accepted
+    step = parallel.FusedTrainStep(lm("null"), {"data": (8, 4)},
+                                   {"label": (8,)},
+                                   mesh=parallel.default_mesh(1),
+                                   grad_accum=2)
+    assert step._accum == 2
