@@ -152,8 +152,10 @@ class Xavier(Initializer):
         self.factor_type = factor_type
         self.magnitude = float(magnitude)
 
-    def _init_weight(self, desc, arr):
-        shape = arr.shape
+    def weight_scale(self, shape):
+        """The per-shape scale of this initializer's distribution —
+        shared with the on-chip init plan (``parallel/fused.py``) so
+        host and device paths cannot drift."""
         hw_scale = 1.0
         if len(shape) < 2:
             fan_in = fan_out = shape[0] if shape else 1
@@ -168,7 +170,11 @@ class Xavier(Initializer):
             factor = fan_in
         else:
             factor = fan_out
-        scale = np.sqrt(self.magnitude / factor)
+        return float(np.sqrt(self.magnitude / factor))
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        scale = self.weight_scale(shape)
         if self.rnd_type == "uniform":
             arr[:] = np.random.uniform(-scale, scale, shape
                                        ).astype(np.float32)

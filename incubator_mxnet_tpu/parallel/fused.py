@@ -37,6 +37,95 @@ _FUSED_OPTS = {
 from ..lowering import lower_symbol as _lower_symbol  # shared lowering
 
 
+def _device_init_plan(initializer, param_names):
+    """name → device-side generator ``fn(key, shape) -> jnp array``
+    for every param, or None when any param needs the host fallback.
+
+    The generator set mirrors ``Initializer.__call__``'s name-pattern
+    dispatch (bias→0, gamma→1, …) plus the weight rule of the exact
+    built-in initializer classes.  Device-side init matters on a
+    tunneled chip: it replaces the H2D upload of every master weight
+    (minutes when tunnel weather degrades, PERF.md §1) with one jitted
+    on-chip program.  Exact-type check only — a subclass may override
+    ``_init_weight`` arbitrarily and must take the host path."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..initializer import (Constant, MSRAPrelu, Normal, One, Uniform,
+                               Xavier, Zero)
+
+    init_t = type(initializer)
+    if init_t not in (Uniform, Normal, Xavier, MSRAPrelu, Zero, One,
+                      Constant):
+        return None
+
+    def fill(v):
+        return lambda key, shape: jnp.full(shape, v, jnp.float32)
+
+    def weight_rule(shape):
+        if init_t is Uniform:
+            s = float(initializer.scale)
+            return lambda key, sh: jax.random.uniform(
+                key, sh, jnp.float32, -s, s)
+        if init_t is Normal:
+            s = float(initializer.sigma)
+            return lambda key, sh: s * jax.random.normal(
+                key, sh, jnp.float32)
+        if init_t is Zero:
+            return fill(0.0)
+        if init_t is One:
+            return fill(1.0)
+        if init_t is Constant:
+            return fill(float(initializer.value))
+        # Xavier / MSRAPrelu: scale is a static function of the shape
+        # — THE shared Xavier.weight_scale, so host/device cannot drift
+        scale = initializer.weight_scale(shape)
+        if initializer.rnd_type == "uniform":
+            return lambda key, sh: jax.random.uniform(
+                key, sh, jnp.float32, -scale, scale)
+        return lambda key, sh: scale * jax.random.normal(
+            key, sh, jnp.float32)
+
+    plan = {}
+    for n, shape in param_names:
+        name = n.lower()
+        if name.endswith("upsampling"):
+            return None  # Bilinear kernels stay on the host path
+        if name.endswith(("bias", "beta", "moving_mean", "running_mean",
+                          "moving_inv_var", "moving_avg")):
+            plan[n] = fill(0.0)
+        elif name.endswith(("gamma", "moving_var", "running_var")):
+            plan[n] = fill(1.0)
+        else:
+            plan[n] = weight_rule(shape)
+    return plan
+
+
+class _HostInitBuffer:
+    """numpy-backed stand-in handed to initializers at setup time.
+
+    Every built-in initializer only reads ``.shape`` and assigns
+    ``arr[:] = <numpy or scalar>``, so param init never needs to touch
+    the device; see the host_init comment for why that matters on a
+    tunneled chip."""
+
+    __slots__ = ("_np",)
+
+    def __init__(self, shape):
+        self._np = np.zeros(shape, np.float32)
+
+    @property
+    def shape(self):
+        return self._np.shape
+
+    def __setitem__(self, key, value):
+        self._np[key] = value.asnumpy() \
+            if hasattr(value, "asnumpy") else value
+
+    def asnumpy(self):
+        return self._np
+
+
 class FusedTrainStep:
     """One-program data-parallel trainer over a mesh.
 
@@ -168,7 +257,6 @@ class FusedTrainStep:
 
         # ---- parameter init (host, then shard) --------------------------
         from ..initializer import InitDesc, Uniform
-        from ..ndarray import zeros as nd_zeros
 
         initializer = initializer or Uniform(0.01)
         rep = replicated_spec(self.mesh)
@@ -187,29 +275,83 @@ class FusedTrainStep:
         def host_init(name, shape):
             # mixed precision: params stay f32 masters; ops cast to the
             # activation dtype at use sites (`cast` forces storage dtype
-            # only when explicitly requested)
-            arr = nd_zeros(shape)
-            initializer(InitDesc(name), arr)
-            a = arr.data
+            # only when explicitly requested).  Init stays ENTIRELY on
+            # host numpy: an on-device scratch would compile a program
+            # per unique shape over the tunnel, and device_put of a
+            # device-resident array round-trips through the ~5 MB/s D2H
+            # path (PERF.md §1) — flagship setup went from ~8 min to
+            # seconds with one clean H2D per tensor.
+            arr = _HostInitBuffer(shape)
+            try:
+                initializer(InitDesc(name), arr)
+                a = arr._np
+            except Exception:
+                # a custom initializer that uses more NDArray surface
+                # than `.shape` + `arr[:] = x` (in-place ops, reads,
+                # out= random calls) gets the real thing — correct but
+                # slow when tunnel weather is bad
+                from ..ndarray import zeros as nd_zeros
+
+                nd = nd_zeros(shape)
+                initializer(InitDesc(name), nd)
+                a = np.asarray(nd.data)
             if cast is not None and name.endswith("weight"):
                 a = a.astype(cast)
             return jax.device_put(a, self._param_sharding[name])
 
-        self.params = {n: host_init(n, shape_of[n])
-                       for n in self.param_names}
-        self.aux = {n: jax.device_put(
-            jnp.ones(s) if n.endswith(("var",)) else jnp.zeros(s), rep)
-            for n, s in zip(aux_names, aux_shapes)}
-        def state_like(p):
-            z = jnp.zeros_like(p) if self._state_dtype is None \
-                else jnp.zeros(p.shape, self._state_dtype)
-            return z
+        plan = None if get_env("HOST_INIT", 0, int) else \
+            _device_init_plan(
+                initializer, [(n, tuple(shape_of[n]))
+                              for n in self.param_names])
+        if plan is not None:
+            # all params recognized: generate masters ON CHIP in one
+            # jitted program, keyed by (seed, crc32(name)) so two
+            # constructions with the same seed are bit-identical
+            import zlib
 
-        self.opt_states = {
-            n: tuple(jax.device_put(state_like(self.params[n]),
-                                    self._param_sharding[n])
-                     for _ in range(self._n_states))
-            for n in self.param_names}
+            base_key = jax.random.PRNGKey(seed)
+
+            def make_params():
+                out = {}
+                for n in self.param_names:
+                    k = jax.random.fold_in(
+                        base_key, zlib.crc32(n.encode()) & 0x7FFFFFFF)
+                    a = plan[n](k, tuple(shape_of[n]))
+                    if cast is not None and n.endswith("weight"):
+                        a = a.astype(cast)
+                    out[n] = a
+                return out
+
+            self.params = jax.jit(
+                make_params,
+                out_shardings={n: self._param_sharding[n]
+                               for n in self.param_names})()
+        else:
+            self.params = {n: host_init(n, shape_of[n])
+                           for n in self.param_names}
+        self.aux = {n: jax.device_put(
+            np.ones(s, np.float32) if n.endswith(("var",))
+            else np.zeros(s, np.float32), rep)
+            for n, s in zip(aux_names, aux_shapes)}
+        # optimizer states: ONE jitted program materializes every zero
+        # buffer directly into its sharding — no per-shape dispatch, no
+        # host->device transfer of 2×params of zeros
+        if self._n_states:
+            def make_states():
+                return {
+                    n: tuple(jnp.zeros(
+                        self.params[n].shape,
+                        self._state_dtype or self.params[n].dtype)
+                        for _ in range(self._n_states))
+                    for n in self.param_names}
+
+            out_sh = {n: tuple(self._param_sharding[n]
+                               for _ in range(self._n_states))
+                      for n in self.param_names}
+            self.opt_states = jax.jit(
+                make_states, out_shardings=out_sh)()
+        else:
+            self.opt_states = {n: () for n in self.param_names}
         self._key = jax.random.PRNGKey(seed)
         self._step_fn = self._build(shapes)
 

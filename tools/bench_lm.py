@@ -52,17 +52,26 @@ def lm_train_step_flops(batch, seq, embed, layers, vocab,
     return 3.0 * (proj + att + head)
 
 
-def main():
-    small = os.environ.get("TP_LM_SMALL") == "1"
-    B = int(os.environ.get("TP_LM_BATCH", "2" if small else "8"))
-    S = int(os.environ.get("TP_LM_SEQ", "16" if small else "2048"))
-    E = int(os.environ.get("TP_LM_EMBED", "32" if small else "512"))
-    L = int(os.environ.get("TP_LM_LAYERS", "1" if small else "4"))
-    V = int(os.environ.get("TP_LM_VOCAB", "64" if small else "32000"))
-    steps = int(os.environ.get("TP_LM_STEPS", "2" if small else "10"))
-    dtype = os.environ.get("TP_LM_DTYPE",
-                           "float32" if small else "bfloat16")
-    head = os.environ.get("TP_LM_HEAD", "fused")
+def run(defaults=None):
+    """Run the LM benchmark and RETURN the record dict (library entry —
+    ``bench.py`` reuses this so the driver-captured benchmark artifact
+    itself carries the flagship MFU number).  ``defaults`` overrides the
+    built-in config defaults; TP_LM_* env vars still win over both."""
+    d = dict(defaults or {})
+    small = os.environ.get(
+        "TP_LM_SMALL", "1" if d.get("small") else "") == "1"
+
+    def cfg(name, fallback):
+        return os.environ.get(name, str(d.get(name, fallback)))
+
+    B = int(cfg("TP_LM_BATCH", "2" if small else "8"))
+    S = int(cfg("TP_LM_SEQ", "16" if small else "2048"))
+    E = int(cfg("TP_LM_EMBED", "32" if small else "512"))
+    L = int(cfg("TP_LM_LAYERS", "1" if small else "4"))
+    V = int(cfg("TP_LM_VOCAB", "64" if small else "32000"))
+    steps = int(cfg("TP_LM_STEPS", "2" if small else "10"))
+    dtype = cfg("TP_LM_DTYPE", "float32" if small else "bfloat16")
+    head = cfg("TP_LM_HEAD", "fused")
     sustained = float(os.environ.get("TP_SUSTAINED_TFLOPS", "154"))
     peak = float(os.environ.get("TP_PEAK_TFLOPS", "197"))
 
@@ -114,7 +123,7 @@ def main():
     step_flops = lm_train_step_flops(B, S, E, L, V,
                                      causal_skips_masked=flash)
     tflops = step_flops * steps / dt / 1e12
-    print(json.dumps({
+    return {
         "metric": "transformer_lm_train_tokens_per_sec",
         "value": round(B * S * steps / dt, 1),
         "unit": "tokens/s",
@@ -122,7 +131,11 @@ def main():
         "vocab": V, "dtype": dtype, "head": head,
         "model_tflops_per_sec": round(tflops, 1),
         "mfu_vs_sustained": round(tflops / sustained, 3),
-        "mfu_vs_peak": round(tflops / peak, 3)}))
+        "mfu_vs_peak": round(tflops / peak, 3)}
+
+
+def main():
+    print(json.dumps(run()))
 
 
 if __name__ == "__main__":
