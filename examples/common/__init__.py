@@ -17,3 +17,6 @@ if os.environ.get("TP_EXAMPLES_FORCE_CPU") == "1":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    _n = int(os.environ.get("TP_EXAMPLES_CPU_DEVICES", "0"))
+    if _n > 1:  # virtual device mesh for --pipeline / multi-device runs
+        jax.config.update("jax_num_cpu_devices", _n)

@@ -44,20 +44,59 @@ def main():
                     help="recompute policy: 'mirror' or an int K "
                          "(TP_REMAT_SEGMENTS parity)")
     ap.add_argument("--grad-accum", type=int, default=None)
+    ap.add_argument("--pipeline", type=int, default=0, metavar="L",
+                    help="pipeline-parallel training over an L-stage "
+                         "'pp' mesh axis (SymbolPipelineTrainStep: the "
+                         "symbol is auto-partitioned at single-tensor "
+                         "boundaries); microbatches = L; implies the "
+                         "fused head; excludes --remat/--grad-accum")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
+    if args.pipeline:
+        if args.remat is not None or args.grad_accum is not None:
+            ap.error("--pipeline does not compose with --remat/"
+                     "--grad-accum (stages are per-tick checkpointed "
+                     "and microbatch accumulation is the schedule "
+                     "itself)")
+        if args.batch_size % args.pipeline:
+            ap.error("--batch-size must divide into --pipeline "
+                     "microbatches")
 
     V, B, S = args.vocab_size, args.batch_size, args.seq_len
+    # the symbol bakes batch_size into its reshapes: under --pipeline
+    # each stage body sees one microbatch, so build at that size
+    sym_batch = B // args.pipeline if args.pipeline else B
     net = mx.models.transformer_lm(
         vocab_size=V, embed=args.embed, heads=args.heads,
-        num_layers=args.num_layers, seq_len=S, batch_size=B,
-        head="fused" if args.fused_head else "softmax")
+        num_layers=args.num_layers, seq_len=S, batch_size=sym_batch,
+        head="fused" if args.fused_head or args.pipeline else "softmax")
 
     rng = np.random.RandomState(0)
     data = rng.randint(0, V, (args.num_batches, B, S)).astype(np.float32)
     labels = (data + args.shift) % V
 
     mx.random.seed(0)
+    if args.pipeline:
+        from incubator_mxnet_tpu import parallel
+        from incubator_mxnet_tpu.parallel import SymbolPipelineTrainStep
+
+        mesh = parallel.build_mesh({"pp": args.pipeline})
+        step = SymbolPipelineTrainStep(
+            net, {"data": (B, S)}, {"softmax_label": (B, S)},
+            mesh=mesh, num_microbatches=args.pipeline,
+            optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.initializer.Xavier())
+        logging.info("pipeline stages (ops): %s",
+                     [len(s) for s in step.stage_assignment])
+        for epoch in range(args.num_epochs):
+            loss = 0.0
+            for b in range(args.num_batches):
+                loss = step({"data": data[b],
+                             "softmax_label": labels[b]}) / (B * S)
+            logging.info("Epoch[%d] Train-loss=%.4f", epoch, loss)
+        print("done")
+        return 0
     if args.fused_head:
         # the flagship configuration (tools/bench_lm.py): one fused
         # fwd+bwd+adam program, optional remat / grad accumulation

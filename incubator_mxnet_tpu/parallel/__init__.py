@@ -22,7 +22,9 @@ from .collectives import (all_reduce, all_gather, reduce_scatter,
 from .fused import FusedTrainStep
 from .sequence import (attention, ring_attention, ulysses_attention,
                        sequence_parallel_attention)
-from .pipeline import pipeline_apply, pipeline_parallel_apply
+from .pipeline import (pipeline_apply, pipeline_parallel_apply,
+                       PipelineTrainStep)
+from .pipeline_symbol import SymbolPipelineTrainStep
 from .moe import moe_ffn, expert_parallel_moe
 from .vocab_parallel import vocab_parallel_softmax_xent
 from .checkpoint import save_sharded, restore_sharded
@@ -31,5 +33,6 @@ __all__ = ["build_mesh", "default_mesh", "data_parallel_spec",
            "all_reduce", "all_gather", "reduce_scatter", "ring_permute",
            "barrier_sync", "FusedTrainStep", "attention", "ring_attention",
            "ulysses_attention", "sequence_parallel_attention",
-           "pipeline_apply", "pipeline_parallel_apply", "moe_ffn",
+           "pipeline_apply", "pipeline_parallel_apply",
+           "PipelineTrainStep", "SymbolPipelineTrainStep", "moe_ffn",
            "expert_parallel_moe", "save_sharded", "restore_sharded"]

@@ -13,6 +13,7 @@ dynamic scalar input so schedules don't retrigger compilation.
 """
 from __future__ import annotations
 
+import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -305,11 +306,15 @@ class FusedTrainStep:
                               for n in self.param_names])
         if plan is not None:
             # all params recognized: generate masters ON CHIP in one
-            # jitted program, keyed by (seed, crc32(name)) so two
-            # constructions with the same seed are bit-identical
-            import zlib
+            # jitted program, keyed by (global mx.random stream, seed,
+            # crc32(name)).  Drawing next_key() preserves the
+            # mx.random.seed reproducibility contract (random.py:30) the
+            # host-numpy path gets for free: reseeding gives a fresh
+            # deterministic init, two constructions without reseeding
+            # differ — exactly like consuming np.random
+            from .. import random as _random
 
-            base_key = jax.random.PRNGKey(seed)
+            base_key = jax.random.fold_in(_random.next_key(), seed)
 
             def make_params():
                 out = {}

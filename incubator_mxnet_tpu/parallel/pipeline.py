@@ -279,14 +279,24 @@ class PipelineTrainStep:
 
         # ---- parameters (symbol-compatible names) --------------------
         from ..initializer import InitDesc, Uniform
-        from ..ndarray import zeros as nd_zeros
+        from .fused import _HostInitBuffer
 
         initializer = initializer or Uniform(0.01)
 
         def host_init(name, shape):
-            arr = nd_zeros(shape)
-            initializer(InitDesc(name), arr)
-            return np.asarray(arr.data)
+            # host numpy, never a device scratch: on-device zeros +
+            # setitem would compile per shape over the tunnel and the
+            # final device_put round-trips D2H (see fused.host_init)
+            arr = _HostInitBuffer(shape)
+            try:
+                initializer(InitDesc(name), arr)
+                return arr._np
+            except Exception:
+                from ..ndarray import zeros as nd_zeros
+
+                nd = nd_zeros(shape)
+                initializer(InitDesc(name), nd)
+                return np.asarray(nd.data)
 
         E, V, S = embed, vocab_size, seq_len
         dims = {"E": (E,), "EE": (E, E), "4EE": (4 * E, E),

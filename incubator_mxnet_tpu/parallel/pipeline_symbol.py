@@ -1,0 +1,682 @@
+"""Pipeline-parallel training of ARBITRARY Symbols.
+
+Round-4's ``PipelineTrainStep`` (pipeline.py) pipelines one hardcoded
+transformer family; this module stage-partitions ANY layered Symbol —
+the TPU-native generalization of the reference's group2ctx placement
+machinery (``src/executor/graph_executor.cc:279-393`` AssignContext +
+``_CrossDeviceCopy``): the topo order is cut at single-live-tensor
+boundaries into L contiguous stages, device *i* holds stage *i*'s
+parameters (packed into one flat row of a (L, maxP) buffer sharded
+``P('pp')``), and ONE jitted SPMD program runs the GPipe tick loop —
+``lax.switch`` on the pipeline ``axis_index`` dispatches the local
+stage body, ``lax.ppermute`` carries the boundary activation to the
+next device over ICI, gradients accumulate across microbatch ticks
+inside the program, and the same fused optimizer ops as
+``FusedTrainStep`` apply elementwise on the stacked flat buffers.
+
+Key mechanics (and why):
+
+- **Cut discovery**: a cut after topo position ``p`` is valid iff
+  exactly ONE tensor produced at ≤p is consumed at >p (single boundary
+  activation to ppermute) and no parameter/aux variable has consumers
+  on both sides (each stage owns its weights).  Cuts are chosen to
+  balance a matmul-FLOPs cost proxy.
+- **Heterogeneous stages under SPMD**: every device runs the same
+  program, so stage bodies become branches of one ``lax.switch``; the
+  boundary activation travels flattened+padded to the widest cut
+  (f32), each branch unflattening its own side's shape/dtype.
+- **Loss-head gradient gating**: the framework's loss ops
+  (``SoftmaxOutput`` family, the fused xent head) carry custom VJPs
+  that IGNORE the incoming cotangent (reference semantics), so a
+  bubble tick through the last stage would inject garbage analytic
+  gradients that no outer ``where`` can kill.  Every input of the
+  last stage (params, boundary, microbatch) therefore passes through
+  a gate that is identity forward and ``cotangent × valid`` backward,
+  and the bubble boundary is zeroed so the dead math stays finite.
+- **Aux (BN) threading**: each stage updates its local aux only on
+  REAL ticks (bubble executions are masked out), in microbatch order —
+  exactly ``FusedTrainStep(grad_accum=M)``'s sequential-scan semantics,
+  which is the oracle the parity tests use.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError, get_env
+from ..lowering import _interpret
+from ..ops.registry import OpContext, get_op
+
+__all__ = ["SymbolPipelineTrainStep"]
+
+# ops whose custom VJP ignores the incoming cotangent (analytic loss
+# grads, reference semantics) — allowed in the LAST stage only, where
+# the gate masks their bubble-tick gradients
+_LOSS_HEAD_OPS = frozenset({
+    "SoftmaxOutput", "LinearRegressionOutput", "MAERegressionOutput",
+    "LogisticRegressionOutput", "SVMOutput", "make_loss",
+    "_contrib_SoftmaxXentHead",
+})
+
+_gate_cache = []
+
+
+def _grad_gate():
+    """identity forward; backward multiplies the cotangent by ``m``
+    (0.0 on bubble ticks) — see the module docstring."""
+    if _gate_cache:
+        return _gate_cache[0]
+    import jax
+
+    @jax.custom_vjp
+    def gate(x, m):
+        return x
+
+    def fwd(x, m):
+        return x, m
+
+    def bwd(m, ct):
+        return ct * m.astype(ct.dtype), None
+
+    gate.defvjp(fwd, bwd)
+    _gate_cache.append(gate)
+    return gate
+
+
+def _plan_stages(symbol, micro_shapes: Dict[str, Tuple[int, ...]],
+                 n_stages: int):
+    """Partition ``symbol`` into ``n_stages`` contiguous pipeline stages.
+
+    ``micro_shapes``: input name → PER-DEVICE microbatch shape.  Returns
+    the stage plan consumed by ``SymbolPipelineTrainStep._build``.
+    """
+    import jax
+
+    nodes = symbol.topo_nodes()
+    aux_names = set(symbol.list_auxiliary_states())
+    arg_names = symbol.list_arguments()
+    input_names = [n for n in arg_names if n in micro_shapes]
+    param_names = [n for n in arg_names if n not in micro_shapes]
+    arg_shapes, _, aux_shapes = symbol.infer_shape(**micro_shapes)
+    shape_of = dict(zip(arg_names, arg_shapes))
+    aux_shape_of = dict(zip(symbol.list_auxiliary_states(), aux_shapes))
+
+    # ---- probe every entry's shape+dtype at microbatch scale ----------
+    arg_structs = {n: jax.ShapeDtypeStruct(tuple(shape_of[n]), np.float32)
+                   for n in arg_names}
+    aux_structs = {n: jax.ShapeDtypeStruct(tuple(aux_shape_of[n]),
+                                           np.float32)
+                   for n in aux_names}
+
+    id2pos = {id(n): i for i, n in enumerate(nodes)}
+
+    def probe(arg_vals, aux_vals, key):
+        env, _ = _interpret(enumerate(nodes), {}, arg_vals, aux_vals,
+                            key, is_train=True, aux_names=aux_names)
+        # re-key by topo position: ids are process-local, positions are
+        # the stable handle the plan uses
+        return {(id2pos[k[0]], k[1]): v for k, v in env.items()}
+
+    entry_struct = jax.eval_shape(probe, arg_structs, aux_structs,
+                                  jax.random.PRNGKey(0))
+
+    compute = [(ni, n) for ni, n in enumerate(nodes) if not n.is_variable]
+    cpos = {id(n): p for p, (ni, n) in enumerate(compute)}
+    INF = 1 << 30
+
+    # entry (pos, i) → producer compute-position / last consumer
+    prod_at, last_use = {}, {}
+    for (pos, i), st in entry_struct.items():
+        node = nodes[pos]
+        if not node.is_variable:
+            prod_at[(pos, i)] = cpos[id(node)]
+    for p, (ni, node) in enumerate(compute):
+        for inp, idx in node.inputs:
+            if not inp.is_variable:
+                e = (id2pos[id(inp)], idx)
+                last_use[e] = max(last_use.get(e, -1), p)
+    out_entries = [(id2pos[id(n)], i) for n, i in symbol._outputs]
+    for e in out_entries:
+        if e in prod_at:
+            last_use[e] = INF
+
+    # variable consumer spans: params/aux must live in ONE stage
+    var_span = {}
+    for p, (ni, node) in enumerate(compute):
+        for inp, idx in node.inputs:
+            if inp.is_variable and inp.name not in input_names:
+                lo, hi = var_span.get(inp.name, (p, p))
+                var_span[inp.name] = (min(lo, p), max(hi, p))
+
+    ncomp = len(compute)
+    live_count = np.zeros(ncomp, np.int64)
+    live_entry = [None] * ncomp  # the boundary entry when count == 1
+    for e, q in prod_at.items():
+        l = last_use.get(e, -1)
+        for p in range(q, min(l, ncomp - 1)):
+            live_count[p] += 1
+            live_entry[p] = e
+    forbidden = np.zeros(ncomp, bool)
+    for lo, hi in var_span.values():
+        if hi > lo:
+            forbidden[lo:hi] = True
+
+    valid = [p for p in range(ncomp - 1)
+             if live_count[p] == 1 and not forbidden[p]]
+    if n_stages > 1 and len(valid) < n_stages - 1:
+        raise MXNetError(
+            "cannot pipeline this symbol into %d stages: only %d valid "
+            "single-tensor cut points (a cut needs exactly one live "
+            "activation and no parameter used on both sides)"
+            % (n_stages, len(valid)))
+
+    # ---- balanced cut choice (matmul-FLOPs proxy) ---------------------
+    def cost(p):
+        ni, node = compute[p]
+        outs = [st for e, st in entry_struct.items()
+                if e[0] == id2pos[id(node)]]
+        out_elems = sum(int(np.prod(s.shape)) for s in outs)
+        p_elems = sum(int(np.prod(shape_of[inp.name]))
+                      for inp, _ in node.inputs
+                      if inp.is_variable and inp.name in param_names)
+        if p_elems and outs:
+            rows = max(out_elems // max(outs[0].shape[-1], 1)
+                       if outs[0].shape else 1, 1)
+            return float(max(p_elems * rows, out_elems))
+        return float(out_elems)
+
+    costs = [cost(p) for p in range(ncomp)]
+    cum = np.cumsum(costs)
+    total = float(cum[-1])
+    cuts: List[int] = []
+    for k in range(1, n_stages):
+        tgt = total * k / n_stages
+        best = None
+        for j, p in enumerate(valid):
+            if cuts and p <= cuts[-1]:
+                continue
+            # leave enough later cut points for the remaining stages
+            if len(valid) - j - 1 < n_stages - 1 - k:
+                continue
+            d = abs(float(cum[p]) - tgt)
+            if best is None or d < best[0]:
+                best = (d, p)
+        if best is None:
+            raise MXNetError(
+                "cannot balance %d pipeline stages over %d valid cuts"
+                % (n_stages, len(valid)))
+        cuts.append(best[1])
+
+    bounds = [-1] + cuts + [ncomp - 1]
+    stage_of_cpos = np.zeros(ncomp, np.int64)
+    for s in range(n_stages):
+        stage_of_cpos[bounds[s] + 1:bounds[s + 1] + 1] = s
+
+    # loss-head ops only in the last stage (their VJPs ignore the
+    # cotangent; the gate protects only the final stage)
+    for p, (ni, node) in enumerate(compute):
+        if node.op.name in _LOSS_HEAD_OPS and \
+                stage_of_cpos[p] != n_stages - 1:
+            raise MXNetError(
+                "loss op %s (node %s) landed in pipeline stage %d of %d;"
+                " loss heads must be in the final stage — use fewer "
+                "stages or restructure the tail of the network"
+                % (node.op.name, node.name, stage_of_cpos[p], n_stages))
+    for e in out_entries:
+        if e in prod_at and stage_of_cpos[prod_at[e]] != n_stages - 1:
+            raise MXNetError("symbol output produced before the final "
+                             "pipeline stage; cannot pipeline")
+
+    # ---- per-stage structures ----------------------------------------
+    stage_nodes: List[List[Tuple[int, Any]]] = []
+    stage_params: List[List[Tuple[str, int, int, Tuple[int, ...]]]] = []
+    stage_aux: List[List[Tuple[str, int, int, Tuple[int, ...]]]] = []
+    for s in range(n_stages):
+        comp = [compute[p] for p in range(bounds[s] + 1, bounds[s + 1] + 1)]
+        ids = {id(n) for _, n in comp}
+        vars_needed, seen = [], set()
+        for _, node in comp:
+            for inp, idx in node.inputs:
+                if inp.is_variable and id(inp) not in seen:
+                    seen.add(id(inp))
+                    vars_needed.append((id2pos[id(inp)], inp))
+        seg = sorted(vars_needed + [(ni, n) for ni, n in comp])
+        stage_nodes.append(seg)
+        po, pl = 0, []
+        ao, al = 0, []
+        for ni, node in seg:
+            if not node.is_variable:
+                continue
+            nm = node.name
+            if nm in param_names:
+                shp = tuple(shape_of[nm])
+                sz = int(np.prod(shp)) if shp else 1
+                pl.append((nm, po, sz, shp))
+                po += sz
+            elif nm in aux_names:
+                shp = tuple(aux_shape_of[nm])
+                sz = int(np.prod(shp)) if shp else 1
+                al.append((nm, ao, sz, shp))
+                ao += sz
+        stage_params.append(pl)
+        stage_aux.append(al)
+
+    boundaries = []
+    for s in range(n_stages - 1):
+        e = live_entry[cuts[s]]
+        st = entry_struct[e]
+        boundaries.append((e, tuple(st.shape), st.dtype,
+                           max(int(np.prod(st.shape)), 1)))
+
+    return {
+        "nodes": nodes, "id2pos": id2pos,
+        "aux_names": aux_names, "input_names": input_names,
+        "param_names": param_names, "shape_of": shape_of,
+        "aux_shape_of": aux_shape_of,
+        "stage_nodes": stage_nodes, "stage_params": stage_params,
+        "stage_aux": stage_aux, "boundaries": boundaries,
+        "out_entries": out_entries,
+        "max_psize": max([sum(sz for _, _, sz, _ in pl)
+                          for pl in stage_params] + [1]),
+        "max_asize": max([sum(sz for _, _, sz, _ in al)
+                          for al in stage_aux] + [1]),
+        "max_boundary": max([b[3] for b in boundaries] + [1]),
+    }
+
+
+class SymbolPipelineTrainStep:
+    """GPipe-pipelined training of an arbitrary Symbol over a ``pp``
+    mesh axis, composing with data parallelism on the remaining axes.
+
+    ``num_microbatches`` microbatches flow through ``mesh.shape[pp]``
+    stages; gradients sum across microbatches inside one jitted step
+    (aux/BN semantics identical to ``FusedTrainStep(grad_accum=M)``,
+    the oracle its tests compare against), then one fused optimizer
+    update applies on the stage-stacked flat parameter buffer.
+
+    Supports the same optimizer set as ``FusedTrainStep``
+    (sgd/adam/rmsprop/nag/ftrl + lr_scheduler).
+    """
+
+    def __init__(self, symbol, data_shapes: Dict[str, Any],
+                 label_shapes: Optional[Dict[str, Any]] = None,
+                 mesh=None, num_microbatches: int = 4,
+                 axis_name: str = "pp",
+                 optimizer: str = "sgd",
+                 optimizer_params: Optional[Dict[str, Any]] = None,
+                 initializer=None, seed: int = 0):
+        import jax
+
+        from .fused import _FUSED_OPTS, _device_init_plan
+        from .mesh import default_mesh
+
+        self.symbol = symbol
+        self.mesh = mesh if mesh is not None else default_mesh()
+        if axis_name not in self.mesh.axis_names:
+            raise MXNetError("mesh has no %r axis" % axis_name)
+        self.axis_name = axis_name
+        self._L = int(self.mesh.shape[axis_name])
+        self._M = int(num_microbatches)
+        self._data_axes = tuple(a for a in self.mesh.axis_names
+                                if a != axis_name)
+        ndp = 1
+        for a in self._data_axes:
+            ndp *= self.mesh.shape[a]
+        self._ndp = ndp
+
+        label_shapes = label_shapes or {}
+        shapes = dict(data_shapes)
+        shapes.update(label_shapes)
+        self.input_names = list(shapes.keys())
+        self.global_batch = shapes[self.input_names[0]][0]
+        if self.global_batch % (self._M * ndp):
+            raise MXNetError(
+                "global batch %d must divide into %d microbatches x %d "
+                "data-parallel shards"
+                % (self.global_batch, self._M, ndp))
+        for n, s in shapes.items():
+            if not s or s[0] != self.global_batch:
+                raise MXNetError(
+                    "pipelining slices axis 0 of every input; %r has "
+                    "leading dim %s != global batch %d"
+                    % (n, s[0] if s else None, self.global_batch))
+        b = self.global_batch // self._M // ndp
+        micro_shapes = {n: (b,) + tuple(s[1:]) for n, s in shapes.items()}
+        self._micro_shapes = micro_shapes
+
+        self._plan = _plan_stages(symbol, micro_shapes, self._L)
+
+        # ---- optimizer resolution (FusedTrainStep's table) -----------
+        opt_params = dict(optimizer_params or {})
+        self.lr = float(opt_params.pop("learning_rate", 0.01))
+        self.lr_scheduler = opt_params.pop("lr_scheduler", None)
+        momentum = float(opt_params.get("momentum", 0.0))
+        if optimizer == "sgd":
+            if momentum != 0.0:
+                self._opt_op, self._n_states = "sgd_mom_update", 1
+            else:
+                self._opt_op, self._n_states = "sgd_update", 0
+                opt_params.pop("momentum", None)
+        elif optimizer in _FUSED_OPTS:
+            self._opt_op, self._n_states = _FUSED_OPTS[optimizer]
+        else:
+            raise MXNetError(
+                "SymbolPipelineTrainStep does not support optimizer %s"
+                % optimizer)
+        opt_params.setdefault("rescale_grad", 1.0 / self.global_batch)
+        self._opt_attrs = opt_params
+        self.num_update = 0
+
+        # ---- parameters: per-stage flat rows, on-chip init -----------
+        from ..initializer import InitDesc, Uniform
+
+        initializer = initializer or Uniform(0.01)
+        plan = self._plan
+        L, maxP, maxA = self._L, plan["max_psize"], plan["max_asize"]
+        P = jax.sharding.PartitionSpec
+        self._stack_sh = jax.sharding.NamedSharding(self.mesh,
+                                                    P(axis_name))
+        all_named = [(n, tuple(plan["shape_of"][n]))
+                     for pl in plan["stage_params"] for n, _, _, _ in pl]
+        dev_plan = None if get_env("HOST_INIT", 0, int) else \
+            _device_init_plan(initializer, all_named)
+        if dev_plan is not None:
+            import jax.numpy as jnp
+
+            # global-stream keyed like FusedTrainStep: mx.random.seed
+            # alone reproduces the init (random.py:30 contract)
+            from .. import random as _random
+
+            base_key = jax.random.fold_in(_random.next_key(), seed)
+
+            def make_flat():
+                flat = jnp.zeros((L, maxP), jnp.float32)
+                for s in range(L):
+                    for n, off, sz, shp in plan["stage_params"][s]:
+                        k = jax.random.fold_in(
+                            base_key,
+                            zlib.crc32(n.encode()) & 0x7FFFFFFF)
+                        a = dev_plan[n](k, shp).astype(jnp.float32)
+                        flat = flat.at[s, off:off + sz].set(a.reshape(-1))
+                return flat
+
+            self.flat_params = jax.jit(
+                make_flat, out_shardings=self._stack_sh)()
+        else:
+            from .fused import _HostInitBuffer
+
+            flat = np.zeros((L, maxP), np.float32)
+            for s in range(L):
+                for n, off, sz, shp in plan["stage_params"][s]:
+                    arr = _HostInitBuffer(shp)
+                    try:
+                        initializer(InitDesc(n), arr)
+                        a = arr._np
+                    except Exception:
+                        from ..ndarray import zeros as nd_zeros
+
+                        nd = nd_zeros(shp)
+                        initializer(InitDesc(n), nd)
+                        a = np.asarray(nd.data)
+                    flat[s, off:off + sz] = np.asarray(a, np.float32) \
+                        .reshape(-1)
+            self.flat_params = jax.device_put(flat, self._stack_sh)
+
+        aux0 = np.zeros((L, maxA), np.float32)
+        for s in range(L):
+            for n, off, sz, shp in plan["stage_aux"][s]:
+                v = 1.0 if n.endswith(("var",)) else 0.0
+                aux0[s, off:off + sz] = v
+        self.flat_aux = jax.device_put(aux0, self._stack_sh)
+        if self._n_states:
+            import jax.numpy as jnp
+
+            self.opt_states = jax.jit(
+                lambda: tuple(jnp.zeros((L, maxP), jnp.float32)
+                              for _ in range(self._n_states)),
+                out_shardings=tuple(self._stack_sh
+                                    for _ in range(self._n_states)))()
+        else:
+            self.opt_states = ()
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._step_fn = self._build()
+
+    # ------------------------------------------------------------ build
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from .mesh import shard_map_fn
+
+        plan = self._plan
+        L, M = self._L, self._M
+        axis = self.axis_name
+        data_axes = self._data_axes
+        maxB = plan["max_boundary"]
+        aux_names = plan["aux_names"]
+        out_entries = set(plan["out_entries"])
+        id2pos = plan["id2pos"]
+        gate = _grad_gate()
+
+        def make_branch(s):
+            seg_nodes = tuple(plan["stage_nodes"][s])
+            playout = tuple(plan["stage_params"][s])
+            alayout = tuple(plan["stage_aux"][s])
+            bin_ = plan["boundaries"][s - 1] if s > 0 else None
+            bout = plan["boundaries"][s] if s < L - 1 else None
+            is_last = s == L - 1
+
+            def branch(local_p, local_aux, state_in, t, data, key):
+                slot = jnp.clip(t - s, 0, M - 1)
+                valid = ((t - s >= 0) & (t - s < M)) \
+                    .astype(jnp.float32)
+                mb = {k: v[slot] for k, v in data.items()}
+                if is_last:
+                    # loss-head custom VJPs ignore the cotangent: gate
+                    # every input so bubble-tick analytic grads vanish,
+                    # and zero the bubble boundary to keep them finite
+                    local_p = gate(local_p, valid)
+                    state_in = state_in * valid
+                    mb = {k: gate(v, valid) for k, v in mb.items()}
+                args = {n: local_p[off:off + sz].reshape(shp)
+                        for n, off, sz, shp in playout}
+                args.update(mb)
+                aux_vals = {n: local_aux[off:off + sz].reshape(shp)
+                            for n, off, sz, shp in alayout}
+                env = {}
+                if bin_ is not None:
+                    (pos, i), shp, dt, sz = bin_
+                    node = plan["nodes"][pos]
+                    env[(id(node), i)] = state_in[:sz].reshape(shp) \
+                        .astype(dt)
+                env, new_aux = _interpret(
+                    seg_nodes, env, args, aux_vals, key,
+                    is_train=True, aux_names=aux_names)
+                if bout is not None:
+                    (pos, i), shp, dt, sz = bout
+                    node = plan["nodes"][pos]
+                    y = env[(id(node), i)].astype(jnp.float32) \
+                        .reshape(-1)
+                    state_out = jnp.zeros((maxB,), jnp.float32) \
+                        .at[:sz].set(y)
+                    loss = jnp.float32(0.0)
+                else:
+                    loss = jnp.float32(0.0)
+                    for (pos, i) in out_entries:
+                        node = plan["nodes"][pos]
+                        loss = loss + jnp.sum(
+                            env[(id(node), i)].astype(jnp.float32))
+                    state_out = jnp.zeros((maxB,), jnp.float32)
+                aux_out = local_aux
+                for n, off, sz, shp in alayout:
+                    aux_out = aux_out.at[off:off + sz].set(
+                        new_aux[n].astype(jnp.float32).reshape(-1))
+                return state_out, aux_out, loss
+
+            return branch
+
+        branches = [make_branch(s) for s in range(L)]
+
+        def stage_step(local_p, local_aux, state, t, data, tkey):
+            idx = lax.axis_index(axis)
+            return lax.switch(idx, branches, local_p, local_aux, state,
+                              t, data, tkey)
+
+        stage_step = jax.checkpoint(stage_step)
+        perm = [(i, i + 1) for i in range(L - 1)]
+
+        def pipeline_loss(flat_p, flat_aux, data, key):
+            idx = lax.axis_index(axis)
+            local_p = jnp.squeeze(flat_p, 0)
+            local_aux = jnp.squeeze(flat_aux, 0)
+            state = jnp.zeros((maxB,), jnp.float32)
+            loss_sum = jnp.float32(0.0)
+            if hasattr(lax, "pcast"):
+                state = lax.pcast(state, (axis,) + data_axes,
+                                  to="varying")
+                loss_sum = lax.pcast(loss_sum, (axis,) + data_axes,
+                                     to="varying")
+
+            def tick(carry, t):
+                state, aux_l, loss_sum = carry
+                s_out, aux_new, loss = stage_step(
+                    local_p, aux_l, state, t, data,
+                    jax.random.fold_in(key, t))
+                real = ((t - idx >= 0) & (t - idx < M))
+                aux_l = jnp.where(real, aux_new, aux_l)
+                loss_sum = loss_sum + loss * real.astype(jnp.float32)
+                state = lax.ppermute(s_out, axis, perm)
+                return (state, aux_l, loss_sum), None
+
+            (state, aux_l, loss_sum), _ = lax.scan(
+                tick, (state, local_aux, loss_sum),
+                jnp.arange(M + L - 1))
+            total = lax.psum(loss_sum, (axis,) + data_axes)
+            if data_axes:
+                # BN-style aux updates come from LOCAL dp-shard stats
+                # (per-device BN, the reference's semantics); average
+                # them so the replicated-over-dp output is well-defined
+                aux_l = lax.pmean(aux_l, data_axes)
+            return total, aux_l[None]
+
+        P = jax.sharding.PartitionSpec
+        data_spec = {n: P(None, data_axes if data_axes else None)
+                     for n in self.input_names}
+        shard_map = shard_map_fn()
+        smap_kw = dict(mesh=self.mesh,
+                       in_specs=(P(axis), P(axis), data_spec, P()),
+                       out_specs=(P(), P(axis)))
+        try:
+            sharded_loss = shard_map(pipeline_loss, check_vma=False,
+                                     **smap_kw)
+        except TypeError:  # pragma: no cover - older jax
+            sharded_loss = shard_map(pipeline_loss, check_rep=False,
+                                     **smap_kw)
+
+        opt_op = get_op(self._opt_op)
+        opt_attrs = dict(self._opt_attrs)
+        n_states = self._n_states
+        is_adam = self._opt_op == "adam_update"
+        b1 = float(opt_attrs.get("beta1", 0.9))
+        b2 = float(opt_attrs.get("beta2", 0.999))
+
+        def step(flat_p, opt_states, flat_aux, lr, t, data, key):
+            if is_adam:
+                lr = lr * jnp.sqrt(1.0 - jnp.power(b2, t)) \
+                    / (1.0 - jnp.power(b1, t))
+
+            def lossf(p):
+                return sharded_loss(p, flat_aux, data, key)
+
+            (loss, new_aux), g = jax.value_and_grad(
+                lossf, has_aux=True)(flat_p)
+            res, _ = opt_op.apply(
+                [flat_p, g.astype(flat_p.dtype)] + list(opt_states),
+                dict(opt_attrs, lr=lr), OpContext(is_train=True))
+            return res[0], tuple(res[1:1 + n_states]), new_aux, loss
+
+        sh = self._stack_sh
+        state_sh = tuple(sh for _ in range(n_states))
+        data_sh = {n: jax.sharding.NamedSharding(self.mesh, data_spec[n])
+                   for n in self.input_names}
+        return jax.jit(step,
+                       in_shardings=(sh, state_sh, sh, None, None,
+                                     data_sh, None),
+                       out_shardings=(sh, state_sh, sh, None),
+                       donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------- call
+    def __call__(self, batch: Dict[str, Any]):
+        """One pipelined train step; returns the SUMMED symbol outputs
+        (for loss-valued heads — the fused xent head, ``MakeLoss`` —
+        this is the batch loss sum; divide by your token/sample count)."""
+        import jax
+        import jax.numpy as jnp
+
+        M = self._M
+        self.num_update += 1
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler \
+            else self.lr
+        data = {}
+        for n in self.input_names:
+            v = np.asarray(batch[n])
+            data[n] = jnp.asarray(v).reshape(
+                (M, v.shape[0] // M) + tuple(v.shape[1:]))
+        self._key, key = jax.random.split(self._key)
+        self.flat_params, self.opt_states, self.flat_aux, loss = \
+            self._step_fn(self.flat_params, self.opt_states,
+                          self.flat_aux, jnp.float32(lr),
+                          jnp.float32(self.num_update), data, key)
+        return float(loss)
+
+    # ------------------------------------------------------------ fence
+    def sync(self) -> float:
+        return float(np.asarray(self.flat_params[0, 0]))
+
+    # ----------------------------------------------------------- params
+    def get_params(self):
+        """name → NDArray for every parameter and aux state (Module /
+        checkpoint-compatible)."""
+        from ..ndarray.ndarray import NDArray
+
+        flat = np.asarray(self.flat_params)
+        aux = np.asarray(self.flat_aux)
+        out = {}
+        for s in range(self._L):
+            for n, off, sz, shp in self._plan["stage_params"][s]:
+                out[n] = NDArray(flat[s, off:off + sz].reshape(shp))
+            for n, off, sz, shp in self._plan["stage_aux"][s]:
+                out[n] = NDArray(aux[s, off:off + sz].reshape(shp))
+        return out
+
+    def set_params(self, arg_params, aux_params=None):
+        """Load named params (+ optional aux) into the stage buffers."""
+        import jax
+
+        def data(v):
+            return np.asarray(v.data if hasattr(v, "data") else v)
+
+        flat = np.asarray(self.flat_params).copy()
+        for s in range(self._L):
+            for n, off, sz, shp in self._plan["stage_params"][s]:
+                if n in arg_params:
+                    flat[s, off:off + sz] = data(arg_params[n]) \
+                        .astype(np.float32).reshape(-1)
+        self.flat_params = jax.device_put(flat, self._stack_sh)
+        if aux_params:
+            aux = np.asarray(self.flat_aux).copy()
+            for s in range(self._L):
+                for n, off, sz, shp in self._plan["stage_aux"][s]:
+                    if n in aux_params:
+                        aux[s, off:off + sz] = data(aux_params[n]) \
+                            .astype(np.float32).reshape(-1)
+            self.flat_aux = jax.device_put(aux, self._stack_sh)
+
+    @property
+    def stage_assignment(self):
+        """stage → list of op-node names (introspection/tests)."""
+        return [[n.name for _, n in seg if not n.is_variable]
+                for seg in self._plan["stage_nodes"]]
