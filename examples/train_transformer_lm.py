@@ -50,6 +50,12 @@ def main():
                          "symbol is auto-partitioned at single-tensor "
                          "boundaries); microbatches = L; implies the "
                          "fused head; excludes --remat/--grad-accum")
+    ap.add_argument("--moe-experts", type=int, default=0, metavar="E",
+                    help="replace every FFN with a top-2 gated mixture "
+                         "of E experts (_contrib_MoEFFN); trains via "
+                         "FusedTrainStep with expert weights sharded "
+                         "P('ep') when the device count divides by E; "
+                         "logs balance-aux/overflow per epoch")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
     if args.pipeline:
@@ -61,21 +67,66 @@ def main():
         if args.batch_size % args.pipeline:
             ap.error("--batch-size must divide into --pipeline "
                      "microbatches")
+        if args.moe_experts:
+            ap.error("--pipeline with --moe-experts is not supported "
+                     "(route MoE through FusedTrainStep on an ep mesh)")
 
     V, B, S = args.vocab_size, args.batch_size, args.seq_len
     # the symbol bakes batch_size into its reshapes: under --pipeline
     # each stage body sees one microbatch, so build at that size
     sym_batch = B // args.pipeline if args.pipeline else B
+    moe = args.moe_experts
     net = mx.models.transformer_lm(
         vocab_size=V, embed=args.embed, heads=args.heads,
         num_layers=args.num_layers, seq_len=S, batch_size=sym_batch,
-        head="fused" if args.fused_head or args.pipeline else "softmax")
+        moe_experts=moe,
+        head="fused" if args.fused_head or args.pipeline or moe
+        else "softmax")
 
     rng = np.random.RandomState(0)
     data = rng.randint(0, V, (args.num_batches, B, S)).astype(np.float32)
     labels = (data + args.shift) % V
 
     mx.random.seed(0)
+    if moe:
+        import jax
+
+        from incubator_mxnet_tpu import parallel
+
+        remat = args.remat
+        if remat is not None and remat != "mirror":
+            remat = int(remat)
+        P = jax.sharding.PartitionSpec
+        n_dev = len(jax.devices())
+        if n_dev % moe == 0 and n_dev > 1:
+            mesh = parallel.build_mesh({"dp": n_dev // moe, "ep": moe})
+            part = {n: P("ep") for n in net.list_arguments()
+                    if "_moe_w" in n}
+            logging.info("expert-parallel mesh dp%d x ep%d",
+                         n_dev // moe, moe)
+        else:
+            mesh, part = parallel.default_mesh(1), None
+        step = parallel.FusedTrainStep(
+            net, {"data": (B, S)}, {"softmax_label": (B, S)},
+            mesh=mesh, optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.initializer.Xavier(), param_partition=part,
+            remat=remat, grad_accum=args.grad_accum)
+        for epoch in range(args.num_epochs):
+            loss = aux = over = 0.0
+            for b in range(args.num_batches):
+                outs = step({"data": data[b],
+                             "softmax_label": labels[b]})
+                loss = float(np.asarray(outs[0]).mean())
+                # under grad_accum the scalar stats stay stacked
+                # per-microbatch — report the mean
+                aux = float(np.asarray(outs[1]).mean())
+                over = float(np.asarray(outs[2]).mean())
+            logging.info("Epoch[%d] Train-loss=%.4f moe-aux=%.4f "
+                         "moe-overflow=%.4f", epoch, loss, aux, over)
+        print("done")
+        return 0
+
     if args.pipeline:
         from incubator_mxnet_tpu import parallel
         from incubator_mxnet_tpu.parallel import SymbolPipelineTrainStep

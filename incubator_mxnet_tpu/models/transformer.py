@@ -28,7 +28,8 @@ def _merge_heads(x, batch, seq, embed, name):
 
 
 def _block(x, batch, seq, embed, heads, name, causal=True,
-           attn_impl="auto", fused_qkv=False):
+           attn_impl="auto", fused_qkv=False, moe_experts=0,
+           moe_top_k=2, moe_capacity=1.25):
     head_dim = embed // heads
     ln1 = sym.LayerNorm(x, axis=-1, name=name + "_ln1")
     if fused_qkv:
@@ -60,18 +61,45 @@ def _block(x, batch, seq, embed, heads, name, causal=True,
     x = x + proj
 
     ln2 = sym.LayerNorm(x, axis=-1, name=name + "_ln2")
+    if moe_experts:
+        # mixture-of-experts FFN (round-4 verdict #3: MoE as a MODEL
+        # capability, not just a parallel utility): explicit-shape
+        # expert weights so infer_shape stays closed-form
+        hdim = 4 * embed
+        gate = sym.Variable(name + "_moe_gate_weight",
+                            shape=(moe_experts, embed))
+        # per-expert Glorot-uniform: the stacks are (E, out, in) — a
+        # global Xavier would read dim 2+ as conv spatial dims and
+        # scale by the full h·d fan, starting experts ~sqrt(E·h/2)×
+        # too small at realistic widths
+        import math
+
+        from ..initializer import Uniform as _U
+
+        expert_init = _U(math.sqrt(6.0 / (embed + hdim)))
+        w1 = sym.Variable(name + "_moe_w1",
+                          shape=(moe_experts, hdim, embed),
+                          init=expert_init)
+        w2 = sym.Variable(name + "_moe_w2",
+                          shape=(moe_experts, embed, hdim),
+                          init=expert_init)
+        moe = sym.MoEFFN(ln2, gate, w1, w2, top_k=moe_top_k,
+                         capacity_factor=moe_capacity,
+                         name=name + "_moe")
+        return x + moe[0], moe[1], moe[2]
     h = sym.FullyConnected(ln2, num_hidden=4 * embed, flatten=False,
                            name=name + "_ffn1")
     h = sym.Activation(h, act_type="relu", name=name + "_ffn_relu")
     h = sym.FullyConnected(h, num_hidden=embed, flatten=False,
                            name=name + "_ffn2")
-    return x + h
+    return x + h, None, None
 
 
 def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
                seq_len=64, batch_size=8, causal=True, dtype="float32",
                attn_impl="auto", head="softmax", fused_qkv=False,
-               **kwargs):
+               moe_experts=0, moe_top_k=2, moe_capacity=1.25,
+               moe_aux_coeff=1e-2, **kwargs):
     """Decoder-only LM.  Inputs ``data`` (B, S) int tokens and
     ``softmax_label`` (B·S,) next-token targets.
 
@@ -85,6 +113,17 @@ def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
     Shapes are static (XLA contract) — batch/seq are build parameters,
     mirroring how ``BucketingModule`` handled variable length in the
     reference RNN family.
+
+    ``moe_experts=E`` replaces every block's FFN with a top-k gated
+    mixture of E experts (``_contrib_MoEFFN``): the symbol then has
+    THREE outputs — [head, scaled aux loss, overflow (grad-blocked)].
+    The aux term is the mean per-layer Switch/GShard balance loss
+    scaled by ``moe_aux_coeff × B × S`` so its gradient pressure
+    matches the SUMMED head loss and stays batch-size-invariant under
+    the optimizer's ``rescale_grad=1/batch`` convention.  Train via
+    ``FusedTrainStep`` with ``param_partition={*_moe_w1/w2: P('ep')}``
+    for expert parallelism (see parallel/moe.py for the explicit-
+    collective twin).
     """
     if embed % heads:
         raise ValueError("embed (%d) must divide by heads (%d)"
@@ -107,10 +146,17 @@ def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
         # bf16 activations (f32 masters stay f32 in FusedTrainStep);
         # logits cast back before the softmax, like the CNN families
         x = sym.Cast(x, dtype=dtype, name="to_lowp")
+    auxes, overflows = [], []
     for i in range(num_layers):
-        x = _block(x, batch_size, seq_len, embed, heads,
-                   "block%d" % i, causal=causal, attn_impl=attn_impl,
-                   fused_qkv=fused_qkv)
+        x, aux, over = _block(x, batch_size, seq_len, embed, heads,
+                              "block%d" % i, causal=causal,
+                              attn_impl=attn_impl, fused_qkv=fused_qkv,
+                              moe_experts=moe_experts,
+                              moe_top_k=moe_top_k,
+                              moe_capacity=moe_capacity)
+        if aux is not None:
+            auxes.append(aux)
+            overflows.append(over)
     x = sym.LayerNorm(x, axis=-1, name="ln_f")
     x = sym.Reshape(x, shape=(batch_size * seq_len, embed),
                     name="flatten_positions")
@@ -120,9 +166,26 @@ def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
     label_flat = sym.Reshape(label, shape=(-1,), name="label_flat")
     if head == "fused":
         w = sym.Variable("lm_head_weight")
-        return sym.SoftmaxXentHead(x, w, label_flat,
-                                   num_hidden=vocab_size, name="softmax")
-    logits = sym.FullyConnected(x, num_hidden=vocab_size, name="lm_head")
-    if dtype in ("float16", "bfloat16"):
-        logits = sym.Cast(logits, dtype="float32", name="logits_f32")
-    return sym.SoftmaxOutput(logits, label_flat, name="softmax")
+        out = sym.SoftmaxXentHead(x, w, label_flat,
+                                  num_hidden=vocab_size, name="softmax")
+    else:
+        logits = sym.FullyConnected(x, num_hidden=vocab_size,
+                                    name="lm_head")
+        if dtype in ("float16", "bfloat16"):
+            logits = sym.Cast(logits, dtype="float32",
+                              name="logits_f32")
+        out = sym.SoftmaxOutput(logits, label_flat, name="softmax")
+    if not auxes:
+        return out
+    aux_total = auxes[0]
+    over_total = overflows[0]
+    for a in auxes[1:]:
+        aux_total = aux_total + a
+    for o in overflows[1:]:
+        over_total = over_total + o
+    # summed-loss units: coeff × tokens × mean-layer aux (docstring)
+    aux_scaled = aux_total * (moe_aux_coeff * batch_size * seq_len
+                              / num_layers)
+    over_mean = sym.BlockGrad(over_total * (1.0 / num_layers),
+                              name="moe_overflow")
+    return sym.Group([out, aux_scaled, over_mean])

@@ -82,3 +82,82 @@ def _dot_product_attention(ins, attrs, ctx):
     scale = parse_float(scale) if scale is not None else None
     impl = attrs.get("impl", "auto")
     return attention(q, k, v, causal=causal, scale=scale, impl=impl)
+
+
+@register("_contrib_MoEFFN",
+          arg_names=["data", "gate_weight", "expert_w1", "expert_w2"],
+          num_outputs=3, aliases=["MoEFFN"])
+def _moe_ffn_op(ins, attrs, ctx):
+    """Top-k gated mixture-of-experts FFN, global (pjit) semantics.
+
+    ``data`` (..., d); ``gate_weight`` (E, d); ``expert_w1`` (E, h, d);
+    ``expert_w2`` (E, d, h) — FullyConnected (out, in) convention per
+    expert.  Attrs: ``top_k`` (2, renormalized GShard gates; 1 =
+    Switch), ``capacity_factor`` (1.25; over-capacity assignments drop
+    in token order).  Outputs: ``out`` (..., d); ``aux_loss`` () — the
+    Switch/GShard load-balancing loss E·Σ f_e·P_e with f_e counted
+    PRE-capacity (kept-only counting would let a collapsed router hide
+    behind its own overflow); ``overflow`` () — dropped fraction.
+
+    Not in the reference (v0.11 predates MoE; SURVEY §2.4 "absent EP").
+    Written with dense/global ops so it trains through FusedTrainStep
+    on ANY mesh: shard expert_w1/expert_w2 over an 'ep' axis via
+    ``param_partition`` and the XLA SPMD partitioner keeps the expert
+    einsums device-local, lowering the dispatch scatter/gather to
+    collectives over ICI (the shard_map twin with EXPLICIT all_to_all
+    is parallel/moe.py; this op is the model-building face).
+    """
+    import math
+
+    from jax import lax
+
+    x, gw, w1, w2 = ins
+    E = w1.shape[0]
+    k = min(parse_int(attrs.get("top_k", 2)), E)
+    cf = parse_float(attrs.get("capacity_factor", 1.25))
+    d = x.shape[-1]
+    lead = x.shape[:-1]
+    T = 1
+    for s in lead:
+        T *= int(s)
+    xf = x.reshape(T, d)
+    # gating in f32 regardless of activation dtype (tiny, and router
+    # logits are numerically delicate)
+    logits = xf.astype(jnp.float32) @ gw.astype(jnp.float32).T
+    probs = jax.nn.softmax(logits, axis=-1)                  # (T, E)
+    gate_vals, experts = lax.top_k(probs, k)                 # (T, k)
+    if k > 1:
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+    cap = max(int(math.ceil(cf * k * T / E)), 1)
+
+    # sparse dispatch, token-major priority (GShard): position of each
+    # assignment within its expert's capacity buffer via cumsum
+    flat_e = experts.reshape(-1)                             # (T*k,)
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.float32)
+    # int32 running count: a float32 cumsum stops representing
+    # consecutive integers past 2^24 assignments and would silently
+    # collide capacity slots at large T*k
+    oh_i = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)
+    pos = jnp.sum(oh_i * (jnp.cumsum(oh_i, axis=0) - 1), axis=-1)
+    keep = pos < cap
+    safe_pos = jnp.where(keep, pos, 0)
+    tok_idx = jnp.arange(T * k) // k
+    contrib = jnp.where(keep[:, None], xf[tok_idx],
+                        jnp.zeros((1, d), x.dtype))
+    dispatch = jnp.zeros((E, cap, d), x.dtype).at[
+        flat_e, safe_pos].add(contrib)
+
+    h = jax.nn.relu(jnp.einsum("ecd,ehd->ech", dispatch,
+                               w1.astype(x.dtype)))
+    y = jnp.einsum("ech,edh->ecd", h, w2.astype(x.dtype))
+
+    out_flat = y[flat_e, safe_pos]                           # (T*k, d)
+    wgt = keep.astype(x.dtype) * gate_vals.reshape(-1).astype(x.dtype)
+    out = (out_flat * wgt[:, None]).reshape(T, k, d).sum(axis=1)
+    out = out.reshape(tuple(lead) + (d,))
+
+    routed = onehot.sum(0) / (T * k)                         # f_e
+    aux = (E * jnp.sum(routed * probs.mean(0))).astype(jnp.float32)
+    overflow = 1.0 - keep.astype(jnp.float32).mean()
+    return out, aux, overflow

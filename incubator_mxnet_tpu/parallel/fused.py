@@ -38,17 +38,20 @@ _FUSED_OPTS = {
 from ..lowering import lower_symbol as _lower_symbol  # shared lowering
 
 
-def _device_init_plan(initializer, param_names):
-    """name → device-side generator ``fn(key, shape) -> jnp array``
-    for every param, or None when any param needs the host fallback.
+def _fill_rule(v):
+    def rule(key, shape):
+        import jax.numpy as jnp
 
-    The generator set mirrors ``Initializer.__call__``'s name-pattern
-    dispatch (bias→0, gamma→1, …) plus the weight rule of the exact
-    built-in initializer classes.  Device-side init matters on a
-    tunneled chip: it replaces the H2D upload of every master weight
-    (minutes when tunnel weather degrades, PERF.md §1) with one jitted
-    on-chip program.  Exact-type check only — a subclass may override
-    ``_init_weight`` arbitrarily and must take the host path."""
+        return jnp.full(shape, v, jnp.float32)
+
+    return rule
+
+
+def _weight_rule(initializer, shape):
+    """Device-side generator for one weight under ``initializer``, or
+    None when the (exact) class is not a recognized built-in — a
+    subclass may override ``_init_weight`` arbitrarily and must take
+    the host path."""
     import jax
     import jax.numpy as jnp
 
@@ -56,49 +59,74 @@ def _device_init_plan(initializer, param_names):
                                Xavier, Zero)
 
     init_t = type(initializer)
-    if init_t not in (Uniform, Normal, Xavier, MSRAPrelu, Zero, One,
-                      Constant):
-        return None
-
-    def fill(v):
-        return lambda key, shape: jnp.full(shape, v, jnp.float32)
-
-    def weight_rule(shape):
-        if init_t is Uniform:
-            s = float(initializer.scale)
-            return lambda key, sh: jax.random.uniform(
-                key, sh, jnp.float32, -s, s)
-        if init_t is Normal:
-            s = float(initializer.sigma)
-            return lambda key, sh: s * jax.random.normal(
-                key, sh, jnp.float32)
-        if init_t is Zero:
-            return fill(0.0)
-        if init_t is One:
-            return fill(1.0)
-        if init_t is Constant:
-            return fill(float(initializer.value))
-        # Xavier / MSRAPrelu: scale is a static function of the shape
-        # — THE shared Xavier.weight_scale, so host/device cannot drift
+    if init_t is Uniform:
+        s = float(initializer.scale)
+        return lambda key, sh: jax.random.uniform(
+            key, sh, jnp.float32, -s, s)
+    if init_t is Normal:
+        s = float(initializer.sigma)
+        return lambda key, sh: s * jax.random.normal(
+            key, sh, jnp.float32)
+    if init_t is Zero:
+        return _fill_rule(0.0)
+    if init_t is One:
+        return _fill_rule(1.0)
+    if init_t is Constant:
+        return _fill_rule(float(initializer.value))
+    if init_t in (Xavier, MSRAPrelu):
+        # scale is a static function of the shape — THE shared
+        # Xavier.weight_scale, so host/device cannot drift
         scale = initializer.weight_scale(shape)
         if initializer.rnd_type == "uniform":
             return lambda key, sh: jax.random.uniform(
                 key, sh, jnp.float32, -scale, scale)
         return lambda key, sh: scale * jax.random.normal(
             key, sh, jnp.float32)
+    return None
+
+
+def _device_init_plan(initializer, param_names):
+    """name → device-side generator ``fn(key, shape) -> jnp array``
+    for every param, or None when any param needs the host fallback.
+
+    The generator set mirrors ``Initializer.__call__``'s dispatch: a
+    per-variable ``__init__`` attr wins outright (reference InitDesc
+    semantics), then the name patterns (bias→0, gamma→1, …), then the
+    weight rule of the global initializer.  Device-side init matters
+    on a tunneled chip: it replaces the H2D upload of every master
+    weight (minutes when tunnel weather degrades, PERF.md §1) with one
+    jitted on-chip program.  ``param_names`` entries are
+    ``(name, shape)`` or ``(name, shape, attrs)``."""
+    from ..initializer import create as _create_init
 
     plan = {}
-    for n, shape in param_names:
+    for entry in param_names:
+        n, shape = entry[0], entry[1]
+        attrs = entry[2] if len(entry) > 2 else None
+        init_attr = (attrs or {}).get("__init__")
+        if init_attr:
+            try:
+                sub = _create_init(init_attr)
+            except Exception:
+                return None
+            rule = _weight_rule(sub, shape)
+            if rule is None:
+                return None
+            plan[n] = rule
+            continue
         name = n.lower()
         if name.endswith("upsampling"):
             return None  # Bilinear kernels stay on the host path
         if name.endswith(("bias", "beta", "moving_mean", "running_mean",
                           "moving_inv_var", "moving_avg")):
-            plan[n] = fill(0.0)
+            plan[n] = _fill_rule(0.0)
         elif name.endswith(("gamma", "moving_var", "running_var")):
-            plan[n] = fill(1.0)
+            plan[n] = _fill_rule(1.0)
         else:
-            plan[n] = weight_rule(shape)
+            rule = _weight_rule(initializer, shape)
+            if rule is None:
+                return None
+            plan[n] = rule
     return plan
 
 
@@ -273,6 +301,9 @@ class FusedTrainStep:
             else:
                 self._param_sharding[n] = rep
 
+        var_attrs = {node.name: (node.attrs or {})
+                     for node in symbol.topo_nodes() if node.is_variable}
+
         def host_init(name, shape):
             # mixed precision: params stay f32 masters; ops cast to the
             # activation dtype at use sites (`cast` forces storage dtype
@@ -284,7 +315,7 @@ class FusedTrainStep:
             # seconds with one clean H2D per tensor.
             arr = _HostInitBuffer(shape)
             try:
-                initializer(InitDesc(name), arr)
+                initializer(InitDesc(name, var_attrs.get(name)), arr)
                 a = arr._np
             except Exception:
                 # a custom initializer that uses more NDArray surface
@@ -294,7 +325,7 @@ class FusedTrainStep:
                 from ..ndarray import zeros as nd_zeros
 
                 nd = nd_zeros(shape)
-                initializer(InitDesc(name), nd)
+                initializer(InitDesc(name, var_attrs.get(name)), nd)
                 a = np.asarray(nd.data)
             if cast is not None and name.endswith("weight"):
                 a = a.astype(cast)
@@ -302,7 +333,7 @@ class FusedTrainStep:
 
         plan = None if get_env("HOST_INIT", 0, int) else \
             _device_init_plan(
-                initializer, [(n, tuple(shape_of[n]))
+                initializer, [(n, tuple(shape_of[n]), var_attrs.get(n))
                               for n in self.param_names])
         if plan is not None:
             # all params recognized: generate masters ON CHIP in one

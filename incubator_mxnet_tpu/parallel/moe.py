@@ -67,9 +67,12 @@ def moe_ffn(x, gate_w, w1, w2, axis_name: str = "ep", top_k: int = 2,
     # token-major so earlier tokens win capacity, GShard priority)
     flat_e = experts.reshape(-1)                             # (T*k,)
     onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.float32)    # (T*k, E)
-    # position of each assignment within its expert's send buffer
-    pos = jnp.sum(onehot * (jnp.cumsum(onehot, axis=0) - 1.0),
-                  axis=-1).astype(jnp.int32)                 # (T*k,)
+    # position of each assignment within its expert's send buffer —
+    # int32 cumsum: float32 loses consecutive integers past 2^24
+    # assignments and would silently collide capacity slots
+    oh_i = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)
+    pos = jnp.sum(oh_i * (jnp.cumsum(oh_i, axis=0) - 1),
+                  axis=-1)                                   # (T*k,)
     keep = pos < cap
     safe_pos = jnp.where(keep, pos, 0)
     tok_idx = jnp.arange(T * k) // k

@@ -378,7 +378,9 @@ class SymbolPipelineTrainStep:
         P = jax.sharding.PartitionSpec
         self._stack_sh = jax.sharding.NamedSharding(self.mesh,
                                                     P(axis_name))
-        all_named = [(n, tuple(plan["shape_of"][n]))
+        var_attrs = {node.name: (node.attrs or {})
+                     for node in plan["nodes"] if node.is_variable}
+        all_named = [(n, tuple(plan["shape_of"][n]), var_attrs.get(n))
                      for pl in plan["stage_params"] for n, _, _, _ in pl]
         dev_plan = None if get_env("HOST_INIT", 0, int) else \
             _device_init_plan(initializer, all_named)
@@ -412,13 +414,13 @@ class SymbolPipelineTrainStep:
                 for n, off, sz, shp in plan["stage_params"][s]:
                     arr = _HostInitBuffer(shp)
                     try:
-                        initializer(InitDesc(n), arr)
+                        initializer(InitDesc(n, var_attrs.get(n)), arr)
                         a = arr._np
                     except Exception:
                         from ..ndarray import zeros as nd_zeros
 
                         nd = nd_zeros(shp)
-                        initializer(InitDesc(n), nd)
+                        initializer(InitDesc(n, var_attrs.get(n)), nd)
                         a = np.asarray(nd.data)
                     flat[s, off:off + sz] = np.asarray(a, np.float32) \
                         .reshape(-1)

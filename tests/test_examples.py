@@ -119,6 +119,18 @@ def test_train_transformer_lm_pipeline():
         and "done" in out
 
 
+def test_train_transformer_lm_moe():
+    """--moe-experts E: the MoE model family trains through
+    FusedTrainStep on a dp x ep mesh, logging balance/overflow stats
+    (round-4 verdict item #3's example-driver wiring)."""
+    out = _run("train_transformer_lm.py", "--num-epochs", "2",
+               "--seq-len", "16", "--num-batches", "4",
+               "--vocab-size", "16", "--moe-experts", "4",
+               n_devices=4)
+    assert "expert-parallel mesh" in out and "moe-aux=" in out \
+        and "done" in out
+
+
 def test_train_dcgan():
     out = _run("train_dcgan.py", "--num-epochs", "1",
                "--num-batches", "2", "--size", "32")

@@ -316,3 +316,89 @@ def test_pipeline_train_step_composes_with_dp():
         pp_loss = pp(batch)
         np.testing.assert_allclose(pp_loss, fused_loss, rtol=2e-4,
                                    atol=2e-5, err_msg="step %d" % i)
+
+
+# ---------------------------------------------------------------------------
+# MoE as a MODEL capability (round-4 verdict #3): transformer_lm(moe_experts)
+# ---------------------------------------------------------------------------
+
+def test_moe_ffn_op_capacity_and_aux():
+    """_contrib_MoEFFN: output shape preserved; a tiny capacity factor
+    forces overflow; the balance aux is ~1 for a near-uniform router
+    and grows when routing collapses."""
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.registry import OpContext, get_op
+
+    op = get_op("_contrib_MoEFFN")
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(6, 8, 16).astype(np.float32))
+    gw = jnp.asarray(rng.randn(4, 16).astype(np.float32) * 0.01)
+    w1 = jnp.asarray(rng.randn(4, 32, 16).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rng.randn(4, 16, 32).astype(np.float32) * 0.1)
+    (out, aux, over), _ = op.apply([x, gw, w1, w2], {},
+                                   OpContext(is_train=True))
+    assert out.shape == x.shape
+    # near-uniform router (tiny gate weights): aux ~ 1, no overflow
+    assert abs(float(aux) - 1.0) < 0.2
+    assert float(over) < 0.2
+
+    (_, _, over2), _ = op.apply(
+        [x, gw, w1, w2], {"capacity_factor": "0.25"},
+        OpContext(is_train=True))
+    assert float(over2) > 0.4  # tiny capacity drops most assignments
+
+    # collapsed router: positive inputs + one hot gate row push every
+    # token to expert 0 -> aux approaches E (= 4 here), >> balanced 1
+    x_pos = jnp.abs(x) + 0.1
+    gw_bad = jnp.zeros((4, 16), jnp.float32).at[0].set(5.0)
+    (_, aux_bad, _), _ = op.apply([x_pos, gw_bad, w1, w2],
+                                  {"top_k": "1"},
+                                  OpContext(is_train=True))
+    assert float(aux_bad) > 2.0
+
+
+def test_moe_transformer_lm_trains_on_dp_ep_mesh():
+    """transformer_lm(moe_experts=4) through FusedTrainStep on a
+    dp2 x ep4 mesh: expert weights shard P('ep'), the shift task is
+    learned, balance/overflow stats surface every step, and the router
+    (gate) weights actually train."""
+    import jax
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+
+    net = mx.models.transformer_lm(
+        vocab_size=32, embed=32, heads=2, num_layers=2, seq_len=16,
+        batch_size=8, dtype="float32", head="fused", moe_experts=4)
+    moe_args = [n for n in net.list_arguments() if "_moe_" in n]
+    assert len(moe_args) == 6  # gate + w1 + w2 per layer
+    P = jax.sharding.PartitionSpec
+    mesh = parallel.build_mesh({"dp": 2, "ep": 4})
+    part = {n: P("ep") for n in net.list_arguments() if "_moe_w" in n}
+    mx.random.seed(0)
+    step = parallel.FusedTrainStep(
+        net, {"data": (8, 16)}, {"softmax_label": (8, 16)},
+        mesh=mesh, optimizer="adam",
+        optimizer_params={"learning_rate": 1e-2},
+        initializer=mx.initializer.Xavier(), param_partition=part)
+    # the expert stacks are genuinely ep-sharded
+    assert not step.params["block0_moe_w1"].sharding \
+        .is_fully_replicated
+    gate0 = np.asarray(step.params["block0_moe_gate_weight"]).copy()
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, 32, (8, 16)).astype(np.float32)
+    labels = np.roll(data, -1, 1)
+    first = last = None
+    for _ in range(40):
+        outs = step({"data": data, "softmax_label": labels})
+        last = float(np.asarray(outs[0]).mean())
+        if first is None:
+            first = last
+        aux = float(np.asarray(outs[1]))
+        over = float(np.asarray(outs[2]))
+        assert np.isfinite(aux) and 0.0 <= over <= 1.0
+    assert last < first * 0.2, (first, last)
+    # aux-loss gradients reached the router
+    assert np.abs(np.asarray(step.params["block0_moe_gate_weight"])
+                  - gate0).max() > 1e-6
