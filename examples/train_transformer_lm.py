@@ -72,13 +72,12 @@ def main():
                      "(route MoE through FusedTrainStep on an ep mesh)")
 
     V, B, S = args.vocab_size, args.batch_size, args.seq_len
-    # the symbol bakes batch_size into its reshapes: under --pipeline
-    # each stage body sees one microbatch, so build at that size
-    sym_batch = B // args.pipeline if args.pipeline else B
     moe = args.moe_experts
+    # the symbol is batch-polymorphic (-1 reshapes): the same graph
+    # serves full batches, grad-accum microbatches and pipeline stages
     net = mx.models.transformer_lm(
         vocab_size=V, embed=args.embed, heads=args.heads,
-        num_layers=args.num_layers, seq_len=S, batch_size=sym_batch,
+        num_layers=args.num_layers, seq_len=S, batch_size=B,
         moe_experts=moe,
         head="fused" if args.fused_head or args.pipeline or moe
         else "softmax")

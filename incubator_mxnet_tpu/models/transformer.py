@@ -16,7 +16,10 @@ from .. import symbol as sym
 
 def _split_heads(x, batch, seq, heads, head_dim, name):
     # (B, S, E) → (B, H, S, D)
-    r = sym.Reshape(x, shape=(batch, seq, heads, head_dim),
+    # batch stays -1 so the symbol is BATCH-POLYMORPHIC: grad-accum
+    # microbatches and pipeline stage bodies flow through without
+    # rebuilding the graph
+    r = sym.Reshape(x, shape=(-1, seq, heads, head_dim),
                     name=name + "_split")
     return sym.transpose(r, axes=(0, 2, 1, 3), name=name + "_bhsd")
 
@@ -24,7 +27,7 @@ def _split_heads(x, batch, seq, heads, head_dim, name):
 def _merge_heads(x, batch, seq, embed, name):
     # (B, H, S, D) → (B, S, E)
     t = sym.transpose(x, axes=(0, 2, 1, 3), name=name + "_bshd")
-    return sym.Reshape(t, shape=(batch, seq, embed), name=name + "_merge")
+    return sym.Reshape(t, shape=(-1, seq, embed), name=name + "_merge")
 
 
 def _block(x, batch, seq, embed, heads, name, causal=True,
@@ -158,8 +161,7 @@ def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
             auxes.append(aux)
             overflows.append(over)
     x = sym.LayerNorm(x, axis=-1, name="ln_f")
-    x = sym.Reshape(x, shape=(batch_size * seq_len, embed),
-                    name="flatten_positions")
+    x = sym.Reshape(x, shape=(-1, embed), name="flatten_positions")
     # label comes in (B, S) like the PTB LSTM family and flattens to the
     # positions axis inside the graph (lstm_ptb.py:45 convention), so
     # Module's batch-axis slicing stays valid
@@ -183,9 +185,13 @@ def get_symbol(vocab_size=1000, embed=64, heads=4, num_layers=2,
         aux_total = aux_total + a
     for o in overflows[1:]:
         over_total = over_total + o
-    # summed-loss units: coeff × tokens × mean-layer aux (docstring)
-    aux_scaled = aux_total * (moe_aux_coeff * batch_size * seq_len
-                              / num_layers)
+    # summed-loss units: coeff × tokens × mean-layer aux (docstring).
+    # The token count is computed at RUNTIME from the labels (not the
+    # baked batch_size) so grad-accum microbatches scale correctly —
+    # k microbatches each contribute coeff·(B/k)·S, summing to the
+    # intended coeff·B·S
+    tokens = sym.sum(sym.ones_like(label_flat), name="moe_tok_count")
+    aux_scaled = aux_total * tokens * (moe_aux_coeff / num_layers)
     over_mean = sym.BlockGrad(over_total * (1.0 / num_layers),
                               name="moe_overflow")
     return sym.Group([out, aux_scaled, over_mean])

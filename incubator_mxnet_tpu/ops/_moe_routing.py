@@ -1,0 +1,72 @@
+"""Shared MoE token-routing bookkeeping.
+
+ONE implementation of the GShard top-k/capacity/dispatch math, used by
+both MoE faces — the shard_map-local ``parallel/moe.py`` (explicit
+``lax.all_to_all`` over an ``ep`` axis) and the global/pjit
+``_contrib_MoEFFN`` op (``ops/contrib_ops.py``, XLA SPMD partitioning)
+— so routing changes (priority order, capacity formula, renorm
+epsilon) can never silently diverge between the twins.
+
+Deliberately import-neutral: no ``parallel`` imports (the op registry
+loads at package init and must not pull the distribution layer).
+"""
+from __future__ import annotations
+
+__all__ = ["route", "sparse_dispatch", "sparse_combine"]
+
+
+def route(probs, top_k: int, cap: int):
+    """Top-k routing with GShard token-major capacity priority.
+
+    ``probs`` (T, E) router probabilities.  Returns ``(gate_vals,
+    flat_e, onehot, keep, safe_pos)``: renormalized gates (T, k) when
+    k>1 (raw Switch gate at k=1); flat expert ids (T·k,); the f32
+    one-hot (T·k, E) — kept for the PRE-capacity aux-loss counting;
+    the capacity mask; and clamped buffer positions.  Positions come
+    from an int32 cumsum — float32 stops representing consecutive
+    integers past 2^24 assignments and would silently collide slots.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    E = probs.shape[-1]
+    gate_vals, experts = lax.top_k(probs, top_k)
+    if top_k > 1:
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+    flat_e = experts.reshape(-1)
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.float32)
+    oh_i = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)
+    pos = jnp.sum(oh_i * (jnp.cumsum(oh_i, axis=0) - 1), axis=-1)
+    keep = pos < cap
+    safe_pos = jnp.where(keep, pos, 0)
+    return gate_vals, flat_e, onehot, keep, safe_pos
+
+
+def sparse_dispatch(xf, flat_e, keep, safe_pos, E: int, cap: int,
+                    top_k: int):
+    """Scatter tokens into the (E, C, d) capacity buffer — no dense
+    (E, T, d) product; memory/traffic is capacity-bound."""
+    import jax.numpy as jnp
+
+    T = xf.shape[0]
+    d = xf.shape[-1]
+    tok_idx = jnp.arange(T * top_k) // top_k
+    contrib = jnp.where(keep[:, None], xf[tok_idx],
+                        jnp.zeros((1, d), xf.dtype))
+    return jnp.zeros((E, cap, d), xf.dtype).at[
+        flat_e, safe_pos].add(contrib)
+
+
+def sparse_combine(back, flat_e, keep, safe_pos, gate_vals, top_k: int):
+    """Gather each kept assignment's expert output slot and gate-sum
+    over the k assignments per token.  ``back`` (E, C, d)."""
+    import jax.numpy as jnp
+
+    d = back.shape[-1]
+    out_flat = back[flat_e, safe_pos]                       # (T*k, d)
+    wgt = keep.astype(back.dtype) \
+        * gate_vals.reshape(-1).astype(back.dtype)
+    out = (out_flat * wgt[:, None])
+    return out.reshape(-1, top_k, d).sum(axis=1)            # (T, d)

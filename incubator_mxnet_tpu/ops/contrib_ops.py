@@ -109,7 +109,7 @@ def _moe_ffn_op(ins, attrs, ctx):
     """
     import math
 
-    from jax import lax
+    from ._moe_routing import route, sparse_combine, sparse_dispatch
 
     x, gw, w1, w2 = ins
     E = w1.shape[0]
@@ -125,36 +125,16 @@ def _moe_ffn_op(ins, attrs, ctx):
     # logits are numerically delicate)
     logits = xf.astype(jnp.float32) @ gw.astype(jnp.float32).T
     probs = jax.nn.softmax(logits, axis=-1)                  # (T, E)
-    gate_vals, experts = lax.top_k(probs, k)                 # (T, k)
-    if k > 1:
-        gate_vals = gate_vals / jnp.maximum(
-            gate_vals.sum(-1, keepdims=True), 1e-9)
     cap = max(int(math.ceil(cf * k * T / E)), 1)
-
-    # sparse dispatch, token-major priority (GShard): position of each
-    # assignment within its expert's capacity buffer via cumsum
-    flat_e = experts.reshape(-1)                             # (T*k,)
-    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.float32)
-    # int32 running count: a float32 cumsum stops representing
-    # consecutive integers past 2^24 assignments and would silently
-    # collide capacity slots at large T*k
-    oh_i = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)
-    pos = jnp.sum(oh_i * (jnp.cumsum(oh_i, axis=0) - 1), axis=-1)
-    keep = pos < cap
-    safe_pos = jnp.where(keep, pos, 0)
-    tok_idx = jnp.arange(T * k) // k
-    contrib = jnp.where(keep[:, None], xf[tok_idx],
-                        jnp.zeros((1, d), x.dtype))
-    dispatch = jnp.zeros((E, cap, d), x.dtype).at[
-        flat_e, safe_pos].add(contrib)
+    # THE shared GShard routing bookkeeping (ops/_moe_routing.py)
+    gate_vals, flat_e, onehot, keep, safe_pos = route(probs, k, cap)
+    dispatch = sparse_dispatch(xf, flat_e, keep, safe_pos, E, cap, k)
 
     h = jax.nn.relu(jnp.einsum("ecd,ehd->ech", dispatch,
                                w1.astype(x.dtype)))
     y = jnp.einsum("ech,edh->ecd", h, w2.astype(x.dtype))
 
-    out_flat = y[flat_e, safe_pos]                           # (T*k, d)
-    wgt = keep.astype(x.dtype) * gate_vals.reshape(-1).astype(x.dtype)
-    out = (out_flat * wgt[:, None]).reshape(T, k, d).sum(axis=1)
+    out = sparse_combine(y, flat_e, keep, safe_pos, gate_vals, k)
     out = out.reshape(tuple(lead) + (d,))
 
     routed = onehot.sum(0) / (T * k)                         # f_e

@@ -50,39 +50,21 @@ def moe_ffn(x, gate_w, w1, w2, axis_name: str = "ep", top_k: int = 2,
     import jax.numpy as jnp
     from jax import lax
 
+    from ..ops._moe_routing import (route, sparse_combine,
+                                    sparse_dispatch)
+
     E = lax.axis_size(axis_name)
     T, d = x.shape
     logits = x @ gate_w                          # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
     k = min(top_k, E)
-    gate_vals, experts = lax.top_k(probs, k)     # (T, k)
-    if k > 1:
-        gate_vals = gate_vals / jnp.maximum(
-            gate_vals.sum(-1, keepdims=True), 1e-9)
-
     cap = int(np.ceil(capacity_factor * k * T / E))
     cap = max(cap, 1)
 
-    # ---- sparse dispatch bookkeeping (flat over T*k assignments,
-    # token-major so earlier tokens win capacity, GShard priority)
-    flat_e = experts.reshape(-1)                             # (T*k,)
-    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.float32)    # (T*k, E)
-    # position of each assignment within its expert's send buffer —
-    # int32 cumsum: float32 loses consecutive integers past 2^24
-    # assignments and would silently collide capacity slots
-    oh_i = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)
-    pos = jnp.sum(oh_i * (jnp.cumsum(oh_i, axis=0) - 1),
-                  axis=-1)                                   # (T*k,)
-    keep = pos < cap
-    safe_pos = jnp.where(keep, pos, 0)
-    tok_idx = jnp.arange(T * k) // k
-
-    # scatter tokens into the (E, C, d) capacity buffer — no (E, T, d)
-    # dense product; memory/traffic is capacity-bound
-    contrib = jnp.where(keep[:, None], x[tok_idx],
-                        jnp.zeros((1, d), x.dtype))
-    dispatch = jnp.zeros((E, cap, d), x.dtype).at[
-        flat_e, safe_pos].add(contrib)
+    # THE shared GShard routing bookkeeping (ops/_moe_routing.py) —
+    # token-major capacity priority, int32 cumsum positions
+    gate_vals, flat_e, onehot, keep, safe_pos = route(probs, k, cap)
+    dispatch = sparse_dispatch(x, flat_e, keep, safe_pos, E, cap, k)
 
     # all_to_all: expert dim -> source dim; device e now holds, for
     # every source s, the <=C tokens s routed to expert e
@@ -93,12 +75,7 @@ def moe_ffn(x, gate_w, w1, w2, axis_name: str = "ep", top_k: int = 2,
     back = lax.all_to_all(y, axis_name, split_axis=0,
                           concat_axis=0, tiled=True)         # (E, C, d)
 
-    # sparse combine: gather each kept assignment's output slot
-    out_flat = back[flat_e, safe_pos]                        # (T*k, d)
-    out_flat = out_flat * (keep[:, None].astype(x.dtype)
-                           * gate_vals.reshape(-1)[:, None]
-                           .astype(x.dtype))
-    out = out_flat.reshape(T, k, d).sum(axis=1)
+    out = sparse_combine(back, flat_e, keep, safe_pos, gate_vals, k)
 
     # ---- load-balancing aux loss + overflow, averaged over the mesh.
     # f_e is the fraction of assignments ROUTED to e (pre-capacity, the
