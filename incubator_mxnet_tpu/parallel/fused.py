@@ -173,7 +173,7 @@ class FusedTrainStep:
                  param_partition: Optional[Dict[str, Any]] = None,
                  flat_optimizer: bool = False, remat=None,
                  grad_accum: Optional[int] = None,
-                 opt_state_dtype=None):
+                 opt_state_dtype=None, grad_dtype=None):
         import jax
         import jax.numpy as jnp
 
@@ -200,6 +200,13 @@ class FusedTrainStep:
         # step, downcast on store); opt-in, None = f32 masters.
         self._state_dtype = dtype_np(opt_state_dtype) \
             if opt_state_dtype else None
+        # gradient storage/exchange dtype (e.g. "bfloat16"): the grads
+        # leaving the backward are cast BEFORE accumulation and the dp
+        # reduction, so cross-tick accumulators and the all-reduce move
+        # half the bytes (comm-compression lever, SURVEY §5.8; the
+        # remaining headroom named by round-4 verdict #5).  Update math
+        # still upcasts to the master dtype; opt-in, None = f32.
+        self._grad_dtype = dtype_np(grad_dtype) if grad_dtype else None
         self.mesh = mesh if mesh is not None else default_mesh()
         label_shapes = label_shapes or {}
         shapes = dict(data_shapes)
@@ -423,6 +430,11 @@ class FusedTrainStep:
                 ct = ([jnp.ones_like(o) for o in outs],
                       {k: jnp.zeros_like(v) for k, v in new_aux.items()})
                 (g,) = vjp_fn(ct)
+                if self._grad_dtype is not None:
+                    # cast at the backward boundary: accumulation and
+                    # the dp all-reduce then run at half width
+                    g = {n: v.astype(self._grad_dtype)
+                         for n, v in g.items()}
                 return g, outs, new_aux
 
             if self._accum == 1:
@@ -444,7 +456,8 @@ class FusedTrainStep:
                         lambda a, b: a + b, gsum, g)
                     return (new_aux, gsum, i + 1), outs
 
-                gzero = {n: jnp.zeros(v.shape, jnp.float32)
+                gzero = {n: jnp.zeros(v.shape,
+                                      self._grad_dtype or jnp.float32)
                          for n, v in params.items()}
                 (new_aux, grads, _), outs_stacked = jax.lax.scan(
                     body, (aux, gzero, jnp.int32(0)), stacked)

@@ -360,3 +360,39 @@ def test_grad_accum_rejects_non_null_head_normalization():
                                    mesh=parallel.default_mesh(1),
                                    grad_accum=2)
     assert step._accum == 2
+
+
+def test_grad_dtype_bf16_converges():
+    """grad_dtype='bfloat16' casts gradients at the backward boundary
+    (accumulators + dp all-reduce at half width); update math upcasts
+    to f32 masters, so training tracks the f32-grad run — including
+    under grad_accum, where the accumulator itself is bf16."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+
+    d = mx.sym.Variable("data")
+    x = mx.sym.FullyConnected(d, num_hidden=16, name="fc1")
+    x = mx.sym.Activation(x, act_type="relu", name="r1")
+    x = mx.sym.FullyConnected(x, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(x, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+    rng = np.random.RandomState(0)
+    data = rng.randn(16, 8).astype(np.float32)
+    labels = rng.randint(0, 4, (16,)).astype(np.float32)
+    runs = {}
+    for gdt, accum in ((None, 1), ("bfloat16", 1), ("bfloat16", 4)):
+        mx.random.seed(1)
+        step = parallel.FusedTrainStep(
+            net, {"data": (16, 8)}, {"softmax_label": (16,)},
+            mesh=parallel.default_mesh(1), optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.initializer.Xavier(), seed=0,
+            grad_dtype=gdt, grad_accum=accum)
+        for _ in range(20):
+            outs = step({"data": data, "softmax_label": labels})
+        probs = np.asarray(outs[0])
+        nll = -np.log(probs[np.arange(16), labels.astype(int)] + 1e-9)
+        runs[(gdt, accum)] = nll.mean()
+    base = runs[(None, 1)]
+    assert runs[("bfloat16", 1)] < 1.2 * base + 0.05, runs
+    assert runs[("bfloat16", 4)] < 1.3 * base + 0.1, runs

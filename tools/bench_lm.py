@@ -96,6 +96,7 @@ def run(defaults=None):
         mesh=parallel.default_mesh(1), optimizer="adam",
         optimizer_params={"learning_rate": 1e-3},
         opt_state_dtype=os.environ.get("TP_LM_OPT_DTYPE") or None,
+        grad_dtype=os.environ.get("TP_LM_GRAD_DTYPE") or None,
         initializer=mx.initializer.Xavier())
 
     rng = np.random.RandomState(0)
