@@ -118,8 +118,55 @@ def attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
                       v)
 
 
+def _online_block_update(q32, kb, vb, m, l, o, causal, scale, qi, k0,
+                         neg):
+    """One flash-style online-softmax update with key block ``kb``/``vb``
+    whose first key has GLOBAL position ``k0``.  The unit both the ring
+    hop and its sub-hop chunks share."""
+    import jax.numpy as jnp
+
+    s = jnp.einsum("...qd,...kd->...qk", q32,
+                   kb.astype(jnp.float32)) * scale
+    if causal:
+        ki = k0 + jnp.arange(kb.shape[-2])
+        mask = qi[:, None] >= ki[None, :]
+        s = jnp.where(mask, s, neg)
+    m_new = jnp.maximum(m, s.max(-1))
+    # fully-masked rows: keep exp argument finite
+    p = jnp.exp(s - jnp.where(m_new == neg, 0.0, m_new)[..., None])
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.where(m == neg, 0.0,
+                     jnp.exp(m - jnp.where(m_new == neg, 0.0, m_new)))
+    l = l * corr + p.sum(-1)
+    o = o * corr[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p, vb.astype(jnp.float32))
+    return m_new, l, o
+
+
+def _hop_chunks(block_len: int, hop_chunk: int) -> int:
+    """Number of sub-chunks a hop's K/V block is processed in (1 = the
+    dense whole-block path).  Chunking keeps the per-hop (bq × chunk)
+    f32 score temp O(block) instead of O(shard²) — at S/n = 8k the
+    dense block temp is 256 MB+ f32 (round-4 verdict #6).
+
+    Non-divisible shard lengths use the largest divisor ≤ hop_chunk so
+    the memory bound survives (no silent dense fallback); only
+    pathological lengths whose best divisor is tiny (< 128 — prime-ish
+    and lane-unaligned anyway) fall back to dense."""
+    if not hop_chunk or block_len <= hop_chunk:
+        return 1
+    for c in range(int(hop_chunk), 0, -1):
+        if block_len % c == 0:
+            if c < min(128, block_len):
+                return 1
+            return block_len // c
+    return 1
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   hop_chunk: int = 1024):
     """Ring self-attention over a sharded sequence axis.
 
     Call inside shard_map: q/k/v are the LOCAL sequence shards
@@ -134,14 +181,20 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     riding the ring alongside their K/V blocks — per-device memory stays
     O(seq/n) in backward too, instead of reverse-mode-through-
     ``fori_loop`` checkpointing every hop's rotated K/V (O(global seq),
-    the round-3 VERDICT §5.7 gap)."""
+    the round-3 VERDICT §5.7 gap).
+
+    ``hop_chunk``: each hop's K/V block is streamed through the online
+    softmax in ≤hop_chunk-key tiles (when the block divides), so the
+    per-hop f32 score temp is (bq × hop_chunk), O(block), instead of
+    the full (S/n × S/n) — the round-4 verdict #6 constant.  0
+    disables (dense whole-block hops)."""
     d = q.shape[-1]
     scale = (1.0 / d ** 0.5) if scale is None else scale
-    return _ring_attention_vjp(axis_name, bool(causal), float(scale))(
-        q, k, v)
+    return _ring_attention_vjp(axis_name, bool(causal), float(scale),
+                               int(hop_chunk))(q, k, v)
 
 
-def _ring_fwd_pass(q, k, v, axis_name, causal, scale):
+def _ring_fwd_pass(q, k, v, axis_name, causal, scale, hop_chunk):
     """Online-softmax ring forward; returns (out, lse) with lse the
     per-row logsumexp of the GLOBAL score row (the flash residual)."""
     import jax.numpy as jnp
@@ -150,7 +203,10 @@ def _ring_fwd_pass(q, k, v, axis_name, causal, scale):
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     bq = q.shape[-2]
+    bk = k.shape[-2]
     neg = _neg_inf(jnp.float32)
+    nc = _hop_chunks(bk, hop_chunk)
+    chunk = bk // nc
 
     q32 = q.astype(jnp.float32)
     # derive the carries from q so they inherit its varying ('sp') axes —
@@ -161,30 +217,26 @@ def _ring_fwd_pass(q, k, v, axis_name, causal, scale):
 
     perm = [(i, (i + 1) % n) for i in range(n)]
     q_off = idx * bq
+    qi = q_off + jnp.arange(bq)
 
     def body(step, carry):
         kk, vv, m, l, o = carry
         # block (kk, vv) originated on ring neighbor (idx - step) mod n
         owner = (idx - step) % n
-        s = jnp.einsum("...qd,...kd->...qk", q32,
-                       kk.astype(jnp.float32)) * scale
-        if causal:
-            qi = q_off + jnp.arange(bq)
-            ki = owner * kk.shape[-2] + jnp.arange(kk.shape[-2])
-            s = jnp.where(qi[:, None] >= ki[None, :], s, neg)
-        m_new = jnp.maximum(m, s.max(-1))
-        # fully-masked rows: keep exp argument finite
-        p = jnp.exp(s - jnp.where(m_new == neg, 0.0, m_new)[..., None])
-        if causal:
-            p = jnp.where((qi[:, None] >= ki[None, :]), p, 0.0)
-        corr = jnp.where(m == neg, 0.0,
-                         jnp.exp(m - jnp.where(m_new == neg, 0.0, m_new)))
-        l = l * corr + p.sum(-1)
-        o = o * corr[..., None] + jnp.einsum(
-            "...qk,...kd->...qd", p, vv.astype(jnp.float32))
+
+        def one_chunk(c, mlo):
+            kb = lax.dynamic_slice_in_dim(kk, c * chunk, chunk, -2)
+            vb = lax.dynamic_slice_in_dim(vv, c * chunk, chunk, -2)
+            return _online_block_update(
+                q32, kb, vb, *mlo, causal, scale, qi,
+                owner * bk + c * chunk, neg)
+
+        # streaming the hop's block in chunks keeps the f32 score temp
+        # (bq × chunk) instead of (bq × bk) — O(block) at long shards
+        m, l, o = lax.fori_loop(0, nc, one_chunk, (m, l, o))
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
-        return kk, vv, jnp.maximum(m, m_new), l, o
+        return kk, vv, m, l, o
 
     _, _, m, l, o = lax.fori_loop(0, n, body, (k, v, m, l, o))
     out = (o / jnp.where(l == 0.0, 1.0, l)[..., None]).astype(q.dtype)
@@ -195,17 +247,19 @@ def _ring_fwd_pass(q, k, v, axis_name, causal, scale):
 
 
 @functools.lru_cache(maxsize=None)
-def _ring_attention_vjp(axis_name, causal, scale):
+def _ring_attention_vjp(axis_name, causal, scale, hop_chunk):
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     @jax.custom_vjp
     def f(q, k, v):
-        return _ring_fwd_pass(q, k, v, axis_name, causal, scale)[0]
+        return _ring_fwd_pass(q, k, v, axis_name, causal, scale,
+                              hop_chunk)[0]
 
     def f_fwd(q, k, v):
-        out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, scale)
+        out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, scale,
+                                  hop_chunk)
         return out, (q, k, v, out, lse)
 
     def f_bwd(res, do):
@@ -213,7 +267,10 @@ def _ring_attention_vjp(axis_name, causal, scale):
         n = lax.axis_size(axis_name)
         idx = lax.axis_index(axis_name)
         bq = q.shape[-2]
+        bk = k.shape[-2]
         neg = _neg_inf(jnp.float32)
+        nc = _hop_chunks(bk, hop_chunk)
+        chunk = bk // nc
         q32 = q.astype(jnp.float32)
         do32 = do.astype(jnp.float32)
         # delta[r] = Σ_d dO[r,d]·O[r,d] — the softmax-jacobian row term
@@ -221,6 +278,7 @@ def _ring_attention_vjp(axis_name, causal, scale):
 
         perm = [(i, (i + 1) % n) for i in range(n)]
         q_off = idx * bq
+        qi = q_off + jnp.arange(bq)
         dq0 = jnp.zeros_like(q32)
         dk0 = jnp.zeros_like(q32, shape=k.shape)
         dv0 = jnp.zeros_like(q32, shape=v.shape)
@@ -228,26 +286,42 @@ def _ring_attention_vjp(axis_name, causal, scale):
         def body(step, carry):
             kk, vv, dk, dv, dq = carry
             owner = (idx - step) % n
-            kk32 = kk.astype(jnp.float32)
-            s = jnp.einsum("...qd,...kd->...qk", q32, kk32) * scale
-            if causal:
-                qi = q_off + jnp.arange(bq)
-                ki = owner * kk.shape[-2] + jnp.arange(kk.shape[-2])
-                s = jnp.where(qi[:, None] >= ki[None, :], s, neg)
-            # exact probabilities from the saved logsumexp
-            p = jnp.exp(s - lse[..., None])
-            dv_c = jnp.einsum("...qk,...qd->...kd", p, do32)
-            dp = jnp.einsum("...qd,...kd->...qk", do32,
-                            vv.astype(jnp.float32))
-            ds = p * (dp - delta[..., None]) * scale
-            dq = dq + jnp.einsum("...qk,...kd->...qd", ds, kk32)
-            dk_c = jnp.einsum("...qk,...qd->...kd", ds, q32)
+
+            def one_chunk(c, acc):
+                dk, dv, dq = acc
+                off = c * chunk
+                kb = lax.dynamic_slice_in_dim(kk, off, chunk, -2) \
+                    .astype(jnp.float32)
+                vb = lax.dynamic_slice_in_dim(vv, off, chunk, -2) \
+                    .astype(jnp.float32)
+                s = jnp.einsum("...qd,...kd->...qk", q32, kb) * scale
+                if causal:
+                    ki = owner * bk + off + jnp.arange(chunk)
+                    s = jnp.where(qi[:, None] >= ki[None, :], s, neg)
+                # exact probabilities from the saved logsumexp
+                p = jnp.exp(s - lse[..., None])
+                dv_b = jnp.einsum("...qk,...qd->...kd", p, do32)
+                dp = jnp.einsum("...qd,...kd->...qk", do32, vb)
+                ds = p * (dp - delta[..., None]) * scale
+                dq = dq + jnp.einsum("...qk,...kd->...qd", ds, kb)
+                dk_b = jnp.einsum("...qk,...qd->...kd", ds, q32)
+                dk = lax.dynamic_update_slice_in_dim(
+                    dk, lax.dynamic_slice_in_dim(dk, off, chunk, -2)
+                    + dk_b, off, -2)
+                dv = lax.dynamic_update_slice_in_dim(
+                    dv, lax.dynamic_slice_in_dim(dv, off, chunk, -2)
+                    + dv_b, off, -2)
+                return dk, dv, dq
+
+            # chunked like the forward: the per-hop f32 score/p/ds
+            # temps stay (bq × chunk), O(block), at long shards
+            dk, dv, dq = lax.fori_loop(0, nc, one_chunk, (dk, dv, dq))
             # dK/dV accumulators travel WITH their block: after n hops
             # they are back home with every device's contribution
             kk = lax.ppermute(kk, axis_name, perm)
             vv = lax.ppermute(vv, axis_name, perm)
-            dk = lax.ppermute(dk + dk_c, axis_name, perm)
-            dv = lax.ppermute(dv + dv_c, axis_name, perm)
+            dk = lax.ppermute(dk, axis_name, perm)
+            dv = lax.ppermute(dv, axis_name, perm)
             return kk, vv, dk, dv, dq
 
         _, _, dk, dv, dq = lax.fori_loop(
@@ -290,12 +364,14 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
 def sequence_parallel_attention(mesh, q, k, v, axis_name: str = "sp",
                                 causal: bool = False,
                                 scale: Optional[float] = None,
-                                mode: str = "ring"):
+                                mode: str = "ring",
+                                hop_chunk: int = 1024):
     """Jit-compiled sequence-parallel attention over ``mesh``.
 
     q/k/v are GLOBAL arrays (b, h, s, d); the sequence axis is sharded
     over ``axis_name`` and the chosen kernel (``ring`` or ``ulysses``)
-    runs under shard_map.
+    runs under shard_map.  ``hop_chunk`` tunes/disables the ring's
+    per-hop streaming tiles (ignored by ulysses).
     """
     import jax
 
@@ -305,9 +381,13 @@ def sequence_parallel_attention(mesh, q, k, v, axis_name: str = "sp",
 
     P = jax.sharding.PartitionSpec
     spec = P(None, None, axis_name, None)
-    fn = ring_attention if mode == "ring" else ulysses_attention
-    sharded = shard_map(
-        functools.partial(fn, axis_name=axis_name, causal=causal,
-                          scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    if mode == "ring":
+        fn = functools.partial(ring_attention, axis_name=axis_name,
+                               causal=causal, scale=scale,
+                               hop_chunk=hop_chunk)
+    else:
+        fn = functools.partial(ulysses_attention, axis_name=axis_name,
+                               causal=causal, scale=scale)
+    sharded = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec)
     return jax.jit(sharded)(q, k, v)

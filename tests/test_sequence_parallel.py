@@ -194,3 +194,78 @@ def test_vocab_parallel_softmax_xent_matches_oracle():
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
                                rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_chunked_hops_match_dense(causal):
+    """hop_chunk streams each hop's K/V block through the online softmax
+    in tiles; forward AND backward must equal the dense whole-block hop
+    exactly (same math, different blocking)."""
+    from incubator_mxnet_tpu.parallel.mesh import shard_map_fn
+
+    P = jax.sharding.PartitionSpec
+    mesh = build_mesh({"sp": 4})
+    spec = P(None, None, "sp", None)
+    rng = np.random.RandomState(7)
+    # shard block = 512 keys -> hop_chunk=128 gives 4 sub-chunks
+    q, k, v = (jnp.asarray(rng.randn(1, 2, 2048, 16).astype(np.float32))
+               for _ in range(3))
+
+    def run(hop_chunk):
+        ring = shard_map_fn()(
+            lambda q, k, v: ring_attention(q, k, v, "sp", causal=causal,
+                                           hop_chunk=hop_chunk),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+
+        def loss(q, k, v):
+            return jnp.sum(ring(q, k, v) * 0.01)
+
+        out = ring(q, k, v)
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+        return out, g
+
+    out_c, g_c = run(128)
+    out_d, g_d = run(0)   # dense whole-block hops
+    np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_d),
+                               rtol=2e-5, atol=2e-5)
+    for a, b in zip(g_c, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+    # and the chunked result still matches the full-sequence oracle
+    np.testing.assert_allclose(np.asarray(out_c),
+                               _oracle(q, k, v, causal),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_long_shard_temps_are_chunk_bound():
+    """At S/n = 8192 the dense hop would materialize a 256 MB f32 score
+    block per hop; with the default hop_chunk=1024 the compiled temps
+    must stay O(bq x chunk) — the round-4 verdict #6 'constant'."""
+    from incubator_mxnet_tpu.parallel.mesh import shard_map_fn
+
+    P = jax.sharding.PartitionSpec
+    S, n = 16384, 2   # 8192-key shards
+    mesh = build_mesh({"sp": n})
+    spec = P(None, None, "sp", None)
+
+    def temp_bytes(hop_chunk):
+        ring = shard_map_fn()(
+            lambda q, k, v: ring_attention(q, k, v, "sp", causal=True,
+                                           hop_chunk=hop_chunk),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+
+        def loss(q, k, v):
+            return jnp.sum(ring(q, k, v) ** 2)
+
+        q = jax.ShapeDtypeStruct((1, 1, S, 64), jnp.float32)
+        c = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
+            q, q, q).compile()
+        return c.memory_analysis().temp_size_in_bytes
+
+    chunked = temp_bytes(1024)   # the default
+    dense = temp_bytes(0)
+    # dense hop: >= one (8192 x 8192) f32 score block = 256 MB;
+    # chunked: score temps are (8192 x 1024) = 32 MB-class
+    assert dense >= 256 * 1024 * 1024, dense
+    assert chunked < 160 * 1024 * 1024, chunked
+    assert chunked * 2 < dense, (chunked, dense)
