@@ -1,0 +1,256 @@
+"""Stream-URI filesystem layer: local, http(s), S3, HDFS record streams.
+
+Reference analog: the dmlc-core Stream URI dispatch the reference's IO
+stack is built on — ``dmlc::Stream::Create("s3://...")`` lets RecordIO
+iterators read straight from S3/HDFS when built with ``USE_S3=1`` /
+``USE_HDFS=1`` (``make/config.mk:133-141``).  TPU-native redesign: a
+pure-python scheme dispatch returning file-like objects; remote
+schemes are CHUNKED RANGE READERS (real streaming with random access
+— ``seek``/``read`` over HTTP Range / S3 ranged GET — not
+download-the-world), so ``IndexedRecordIO``'s seeks and the
+sequential scanner both work unchanged over remote packs.
+
+Backends:
+- (none) / ``file://`` — local ``open`` (read/write);
+- ``http://`` / ``https://`` — stdlib ``urllib`` Range requests;
+- ``s3://bucket/key`` — ``boto3`` ranged ``get_object`` (gated: a
+  clear ``MXNetError`` when boto3 is absent, matching the reference's
+  compile-time ``USE_S3`` gate at runtime);
+- ``hdfs://`` — ``pyarrow.fs.HadoopFileSystem`` (gated likewise).
+
+Remote streams are read-only; remote WRITE raises (the reference's S3
+write path needed the same credentials machinery — out of scope for a
+zero-egress build).
+"""
+from __future__ import annotations
+
+import io
+from typing import Optional, Tuple
+
+from .base import MXNetError, get_env
+
+__all__ = ["parse_uri", "open_uri", "is_remote", "is_not_found",
+           "RangeStream", "HTTPRangeStream", "S3RangeStream"]
+
+# chunk granularity for remote range reads: big enough to amortize
+# request latency over JPEG-sized records, small enough that an
+# indexed seek does not refetch megabytes
+_CHUNK = 1 << 20
+
+
+def parse_uri(uri: str) -> Tuple[str, str]:
+    """``uri`` → (scheme, rest); local paths have scheme ''."""
+    if "://" not in uri:
+        return "", uri
+    scheme, rest = uri.split("://", 1)
+    return scheme.lower(), rest
+
+
+def is_remote(uri: str) -> bool:
+    return parse_uri(uri)[0] in ("http", "https", "s3", "hdfs")
+
+
+def is_not_found(exc: BaseException) -> bool:
+    """True when ``exc`` means "object does not exist" (HTTP 404 /
+    S3 NoSuchKey / local ENOENT) — callers distinguishing a MISSING
+    sidecar from auth/network failures must not swallow the latter."""
+    if isinstance(exc, FileNotFoundError):
+        return True
+    if getattr(exc, "code", None) == 404:        # urllib HTTPError
+        return True
+    resp = getattr(exc, "response", None)        # botocore ClientError
+    if isinstance(resp, dict):
+        code = str(resp.get("Error", {}).get("Code", ""))
+        if code in ("404", "NoSuchKey", "NotFound"):
+            return True
+        if str(resp.get("ResponseMetadata", {})
+               .get("HTTPStatusCode", "")) == "404":
+            return True
+    return False
+
+
+def _timeout() -> float:
+    # one wedged connection must not hang a prefetch worker (and with
+    # it every reader queued on the record lock) forever
+    return float(get_env("REMOTE_TIMEOUT", 60, int))
+
+
+class RangeStream(io.RawIOBase):
+    """File-like over an abstract ranged fetch: ``_fetch(start, stop)``
+    returns bytes, ``_length()`` the object size.  Reads go through an
+    aligned chunk cache so sequential scans issue one request per
+    ``_CHUNK`` and indexed seeks only fetch the chunks they touch."""
+
+    def __init__(self, cache_chunks: int = 8):
+        super().__init__()
+        self._pos = 0
+        self._size: Optional[int] = None
+        self._cache = {}          # chunk index -> bytes (LRU by dict order)
+        self._max_chunks = max(int(cache_chunks), 1)
+
+    # -- abstract -----------------------------------------------------
+    def _fetch(self, start: int, stop: int) -> bytes:
+        raise NotImplementedError
+
+    def _length(self) -> int:
+        raise NotImplementedError
+
+    # -- io surface ---------------------------------------------------
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    @property
+    def size(self) -> int:
+        if self._size is None:
+            self._size = int(self._length())
+        return self._size
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        if whence == 0:
+            self._pos = offset
+        elif whence == 1:
+            self._pos += offset
+        elif whence == 2:
+            self._pos = self.size + offset
+        else:
+            raise ValueError("bad whence %r" % whence)
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def _chunk(self, ci: int) -> bytes:
+        buf = self._cache.pop(ci, None)
+        if buf is None:
+            start = ci * _CHUNK
+            stop = min(start + _CHUNK, self.size)
+            buf = self._fetch(start, stop)
+        self._cache[ci] = buf     # reinsert = most-recently-used
+        while len(self._cache) > self._max_chunks:
+            self._cache.pop(next(iter(self._cache)))
+        return buf
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            n = max(self.size - self._pos, 0)
+        n = min(n, max(self.size - self._pos, 0))
+        out = []
+        pos = self._pos
+        remaining = n
+        while remaining > 0:
+            ci, off = divmod(pos, _CHUNK)
+            buf = self._chunk(ci)
+            piece = buf[off:off + remaining]
+            if not piece:
+                break
+            out.append(piece)
+            pos += len(piece)
+            remaining -= len(piece)
+        self._pos = pos
+        return b"".join(out)
+
+
+class HTTPRangeStream(RangeStream):
+    """http(s) object via stdlib urllib Range requests."""
+
+    def __init__(self, url: str, cache_chunks: int = 8):
+        super().__init__(cache_chunks)
+        self.url = url
+
+    def _length(self) -> int:
+        import urllib.request
+
+        req = urllib.request.Request(self.url, method="HEAD")
+        with urllib.request.urlopen(req, timeout=_timeout()) as r:
+            cl = r.headers.get("Content-Length")
+            if cl is None:
+                raise MXNetError("remote %s sent no Content-Length"
+                                 % self.url)
+            return int(cl)
+
+    def _fetch(self, start: int, stop: int) -> bytes:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url, headers={"Range": "bytes=%d-%d"
+                               % (start, stop - 1)})
+        with urllib.request.urlopen(req, timeout=_timeout()) as r:
+            body = r.read()
+        # a server that ignores Range returns 200 + the full body:
+        # slicing chunk-relative offsets into it would silently read
+        # the wrong bytes — fail loudly instead
+        if len(body) != stop - start:
+            raise MXNetError(
+                "server for %s ignored the Range request (wanted "
+                "%d bytes [%d, %d), got %d) — remote record streams "
+                "need Range support"
+                % (self.url, stop - start, start, stop, len(body)))
+        return body
+
+
+class S3RangeStream(RangeStream):
+    """s3://bucket/key via boto3 ranged GETs (runtime analog of the
+    reference's USE_S3 build gate)."""
+
+    def __init__(self, bucket: str, key: str, cache_chunks: int = 8):
+        super().__init__(cache_chunks)
+        try:
+            import boto3
+        except ImportError:
+            raise MXNetError(
+                "s3:// record streams need boto3 (the reference gates "
+                "the same capability behind USE_S3=1); pip install "
+                "boto3 or pre-stage the pack locally")
+        self.bucket, self.key = bucket, key
+        self._client = boto3.client("s3")
+
+    def _length(self) -> int:
+        head = self._client.head_object(Bucket=self.bucket,
+                                        Key=self.key)
+        return int(head["ContentLength"])
+
+    def _fetch(self, start: int, stop: int) -> bytes:
+        obj = self._client.get_object(
+            Bucket=self.bucket, Key=self.key,
+            Range="bytes=%d-%d" % (start, stop - 1))
+        return obj["Body"].read()
+
+
+def _open_hdfs(rest: str, mode: str):
+    try:
+        from pyarrow import fs as pafs
+    except ImportError:
+        raise MXNetError(
+            "hdfs:// record streams need pyarrow (the reference gates "
+            "the same capability behind USE_HDFS=1)")
+    host, _, path = rest.partition("/")
+    h, _, p = host.partition(":")
+    hdfs = pafs.HadoopFileSystem(h or "default",
+                                 int(p) if p else 8020)
+    return hdfs.open_input_file("/" + path)
+
+
+def open_uri(uri: str, mode: str = "rb"):
+    """dmlc ``Stream::Create`` analog: open ``uri`` per its scheme.
+
+    Local paths (and ``file://``) honor ``mode``; remote schemes are
+    read-only chunked range streams.  ``TP_REMOTE_CACHE_CHUNKS``
+    tunes the per-stream chunk cache (default 8 × 1 MB)."""
+    scheme, rest = parse_uri(uri)
+    if scheme in ("", "file"):
+        return open(rest if scheme else uri, mode)
+    if "r" not in mode:
+        raise MXNetError(
+            "remote record streams are read-only (%s)" % uri)
+    chunks = get_env("REMOTE_CACHE_CHUNKS", 8, int)
+    if scheme in ("http", "https"):
+        return HTTPRangeStream(uri, chunks)
+    if scheme == "s3":
+        bucket, _, key = rest.partition("/")
+        return S3RangeStream(bucket, key, chunks)
+    if scheme == "hdfs":
+        return _open_hdfs(rest, mode)
+    raise MXNetError("unsupported stream scheme %r (%s)" % (scheme, uri))

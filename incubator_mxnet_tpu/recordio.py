@@ -36,11 +36,16 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        # scheme dispatch (dmlc Stream::Create analog): local paths get
+        # plain files; http(s)/s3/hdfs URIs get chunked range streams
+        # (read-only) — see filesystem.py
+        from .filesystem import open_uri
+
         if self.flag == "w":
-            self.fp = open(self.uri, "wb")
+            self.fp = open_uri(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.fp = open(self.uri, "rb")
+            self.fp = open_uri(self.uri, "rb")
             self.writable = False
         else:
             raise MXNetError("invalid flag %s" % self.flag)
@@ -131,8 +136,44 @@ class IndexedRecordIO(MXRecordIO):
         super().open()
         self.idx = {}
         self.keys = []
+        from .filesystem import is_remote
+
         if self.writable:
             self.fidx = open(self.idx_path, "w")
+        elif is_remote(self.idx_path):
+            # remote .idx sidecar: tiny text file — one ranged read; a
+            # missing sidecar (404 / no such key) falls back to the
+            # framing rescan exactly like the local no-idx path
+            from .filesystem import open_uri
+
+            from .filesystem import is_not_found
+
+            self.fidx = None
+            try:
+                with open_uri(self.idx_path, "rb") as f:
+                    text = f.read().decode("utf-8")
+            except Exception as e:
+                # ONLY a missing sidecar falls back to the framing
+                # rescan; auth/DNS/timeout errors must surface, not
+                # trigger a whole-pack download
+                if not is_not_found(e):
+                    raise
+                cached = getattr(self, "_scan_cache", None)
+                if cached is None:
+                    cached = scan_record_starts(self.uri)
+                    self._scan_cache = cached
+                for i, pos in enumerate(cached):
+                    key = self.key_type(i)
+                    self.idx[key] = pos
+                    self.keys.append(key)
+                return
+            for line in text.splitlines():
+                if not line.strip():
+                    continue
+                parts = line.strip().split("\t")
+                key = self.key_type(parts[0])
+                self.idx[key] = int(parts[1])
+                self.keys.append(key)
         elif not os.path.exists(self.idx_path):
             # no .idx sidecar: rebuild the index by scanning the record
             # framing (native C++ scanner when available — the reference
@@ -263,14 +304,21 @@ def scan_record_starts(uri: str):
     ``.rec`` file — native C++ scanner when available, python framing
     walk otherwise."""
     from . import native
+    from .filesystem import is_remote, open_uri
 
-    scanned = native.recordio_scan(uri)
-    if scanned is not None:
-        offsets, _ = scanned
-        return [int(o) - 8 for o in offsets]  # payload → header start
+    if not is_remote(uri):
+        scanned = native.recordio_scan(uri)
+        if scanned is not None:
+            offsets, _ = scanned
+            return [int(o) - 8 for o in offsets]  # payload → header
     starts = []
-    fsize = os.path.getsize(uri)
-    with open(uri, "rb") as f:
+    with open_uri(uri, "rb") as f:
+        if hasattr(f, "size"):
+            # RangeStream.size is a property; pyarrow NativeFile.size
+            # is a METHOD — handle both
+            fsize = f.size() if callable(f.size) else f.size
+        else:
+            fsize = os.path.getsize(uri)
         while True:
             pos = f.tell()
             head = f.read(8)

@@ -131,6 +131,15 @@ def test_train_transformer_lm_moe():
         and "done" in out
 
 
+def test_train_word2vec_nce():
+    """The NCE example family (reference example/nce-loss): shared-
+    weight Embedding + sampled negatives + LogisticRegressionOutput;
+    the deterministic co-occurrence task must be learned outright."""
+    out = _run("train_word2vec_nce.py", "--num-epochs", "8",
+               "--vocab-size", "128", "--num-batches", "8")
+    assert "nce-accuracy=1.0000" in out and "done" in out
+
+
 def test_train_dcgan():
     out = _run("train_dcgan.py", "--num-epochs", "1",
                "--num-batches", "2", "--size", "32")
