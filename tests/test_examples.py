@@ -131,6 +131,14 @@ def test_train_transformer_lm_moe():
         and "done" in out
 
 
+def test_train_neural_style():
+    """The neural-style family (reference example/neural-style):
+    gradients flow to the INPUT image (attach_grad on a non-parameter)
+    — the loss must descend by an order of magnitude."""
+    out = _run("train_neural_style.py", "--steps", "25", "--size", "40")
+    assert "style-loss" in out and "done" in out
+
+
 def test_train_word2vec_nce():
     """The NCE example family (reference example/nce-loss): shared-
     weight Embedding + sampled negatives + LogisticRegressionOutput;

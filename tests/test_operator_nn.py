@@ -876,6 +876,8 @@ COVERED_ELSEWHERE = {
     "_contrib_MultiProposal": "test_detection.py",
     "ROIPooling": "test_detection.py",
     "_contrib_ROIPooling": "test_detection.py",
+    "_contrib_MoEFFN": "test_pipeline_moe.py",
+    "MoEFFN": "test_pipeline_moe.py",
 }
 
 

@@ -95,8 +95,8 @@ def run(defaults=None):
         net, {"data": (B, S)}, {"softmax_label": (B, S)},
         mesh=parallel.default_mesh(1), optimizer="adam",
         optimizer_params={"learning_rate": 1e-3},
-        opt_state_dtype=os.environ.get("TP_LM_OPT_DTYPE") or None,
-        grad_dtype=os.environ.get("TP_LM_GRAD_DTYPE") or None,
+        opt_state_dtype=cfg("TP_LM_OPT_DTYPE", "") or None,
+        grad_dtype=cfg("TP_LM_GRAD_DTYPE", "") or None,
         initializer=mx.initializer.Xavier())
 
     rng = np.random.RandomState(0)
@@ -130,6 +130,10 @@ def run(defaults=None):
         "unit": "tokens/s",
         "batch": B, "seq_len": S, "embed": E, "layers": L,
         "vocab": V, "dtype": dtype, "head": head,
+        # config provenance: env can override any knob, so the record
+        # states what ACTUALLY ran (a "tuned" label alone could lie)
+        "opt_state_dtype": cfg("TP_LM_OPT_DTYPE", "") or "float32",
+        "grad_dtype": cfg("TP_LM_GRAD_DTYPE", "") or "float32",
         "model_tflops_per_sec": round(tflops, 1),
         "mfu_vs_sustained": round(tflops / sustained, 3),
         "mfu_vs_peak": round(tflops / peak, 3)}
