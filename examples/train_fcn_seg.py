@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Fully-convolutional semantic segmentation (FCN)::
+
+    python examples/train_fcn_seg.py --num-epochs 6
+
+Port of the reference FCN example family (``example/fcn-xs``): a conv
+encoder downsamples, a ``Deconvolution`` (transposed conv) upsamples
+back to input resolution, and per-pixel classification goes through
+``SoftmaxOutput(multi_output=True)`` — the surface no classification
+driver touches (upsampling kernels + the spatial softmax axis).
+
+The synthetic task segments images of random bright rectangles and
+disks on a dark background into {background, rectangle, disk} — fully
+learnable, so pixel accuracy is a real correctness check.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import common  # noqa: E402,F401  (TP_EXAMPLES_FORCE_CPU device pin)
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+
+
+def fcn_net(num_classes=3):
+    """conv(s2) → conv(s2) → conv → 4× Deconvolution upsample →
+    1×1 score conv → per-pixel softmax (reference fcn-xs topology,
+    shrunk)."""
+    x = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")   # (B, H*W) int classes
+    x = mx.sym.Convolution(x, num_filter=16, kernel=(5, 5),
+                           stride=(2, 2), pad=(2, 2), name="c1")
+    x = mx.sym.Activation(x, act_type="relu", name="r1")
+    x = mx.sym.Convolution(x, num_filter=32, kernel=(3, 3),
+                           stride=(2, 2), pad=(1, 1), name="c2")
+    x = mx.sym.Activation(x, act_type="relu", name="r2")
+    x = mx.sym.Convolution(x, num_filter=32, kernel=(3, 3),
+                           pad=(1, 1), name="c3")
+    x = mx.sym.Activation(x, act_type="relu", name="r3")
+    # 4x bilinear-style learnable upsample back to full resolution
+    x = mx.sym.Deconvolution(x, num_filter=16, kernel=(8, 8),
+                             stride=(4, 4), pad=(2, 2), name="up4")
+    x = mx.sym.Activation(x, act_type="relu", name="r4")
+    score = mx.sym.Convolution(x, num_filter=num_classes,
+                               kernel=(1, 1), name="score")
+    score = mx.sym.Reshape(score, shape=(0, num_classes, -1),
+                           name="score_flat")
+    return mx.sym.SoftmaxOutput(score, label, multi_output=True,
+                                name="softmax")
+
+
+def make_images(rng, n, size):
+    imgs = np.zeros((n, 1, size, size), np.float32)
+    masks = np.zeros((n, size, size), np.float32)
+    yy, xx = np.mgrid[0:size, 0:size]
+    for i in range(n):
+        for _ in range(2):
+            kind = rng.randint(2)
+            cy, cx = rng.randint(8, size - 8, 2)
+            r = rng.randint(4, 8)
+            if kind == 0:                      # rectangle → class 1
+                sel = (abs(yy - cy) < r) & (abs(xx - cx) < r)
+                cls = 1
+            else:                              # disk → class 2
+                sel = (yy - cy) ** 2 + (xx - cx) ** 2 < r * r
+                cls = 2
+            imgs[i, 0][sel] = 0.5 + 0.5 * rng.rand()
+            masks[i][sel] = cls
+    imgs += 0.05 * rng.randn(*imgs.shape).astype(np.float32)
+    return imgs, masks.reshape(n, -1)
+
+
+def main():
+    ap = argparse.ArgumentParser(description="FCN segmentation")
+    ap.add_argument("--size", type=int, default=32)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-epochs", type=int, default=6)
+    ap.add_argument("--num-batches", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    B, S = args.batch_size, args.size
+    rng = np.random.RandomState(0)
+    imgs, masks = make_images(rng, args.num_batches * B, S)
+
+    mx.random.seed(0)
+    net = fcn_net()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (B, 1, S, S))],
+             label_shapes=[("softmax_label", (B, S * S))])
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                          factor_type="in", magnitude=2))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+    from incubator_mxnet_tpu.io import DataBatch
+
+    for epoch in range(args.num_epochs):
+        correct = total = 0
+        for b in range(args.num_batches):
+            sl = slice(b * B, (b + 1) * B)
+            batch = DataBatch([mx.nd.array(imgs[sl])],
+                              [mx.nd.array(masks[sl])])
+            mod.forward_backward(batch)
+            mod.update()
+            pred = mod.get_outputs()[0].asnumpy().argmax(1)  # (B, H*W)
+            correct += (pred == masks[sl]).sum()
+            total += pred.size
+        logging.info("Epoch[%d] pixel-accuracy=%.4f", epoch,
+                     correct / total)
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,10 +57,22 @@ def _layout_is_nhwc(attrs, nd):
 # FullyConnected
 # ---------------------------------------------------------------------------
 
-def _fc_args(attrs):
-    if parse_bool(attrs.get("no_bias", False)):
-        return ["data", "weight"]
-    return ["data", "weight", "bias"]
+def _bias_args(no_bias_default):
+    """arg-name rule for the FC/conv family: bias arg present unless
+    no_bias.  The defaults DIFFER per op in the reference —
+    ConvolutionParam no_bias=false, DeconvolutionParam no_bias=TRUE
+    (deconvolution-inl.h:90) — one factory keeps the rule in one
+    place."""
+
+    def args(attrs):
+        if parse_bool(attrs.get("no_bias", no_bias_default)):
+            return ["data", "weight"]
+        return ["data", "weight", "bias"]
+
+    return args
+
+
+_fc_args = _bias_args(False)
 
 
 def _fc_infer_shape(in_shapes, attrs):
@@ -97,10 +109,8 @@ def _fully_connected(ins, attrs, ctx):
 # Convolution / Deconvolution
 # ---------------------------------------------------------------------------
 
-def _conv_args(attrs):
-    if parse_bool(attrs.get("no_bias", False)):
-        return ["data", "weight"]
-    return ["data", "weight", "bias"]
+_conv_args = _bias_args(False)
+_deconv_args = _bias_args(True)
 
 
 def _conv_out_dim(i, k, s, p, d):
@@ -191,12 +201,12 @@ def _deconv_infer_shape(in_shapes, attrs):
     return shapes, [out], []
 
 
-@register("Deconvolution", arg_names=_conv_args,
+@register("Deconvolution", arg_names=_deconv_args,
           infer_shape=_deconv_infer_shape)
 def _deconvolution(ins, attrs, ctx):
     """Transposed convolution (``src/operator/deconvolution-inl.h``): the
     gradient of Convolution wrt its input, expressed as lhs-dilated conv."""
-    x, w = ins[0], ins[1]
+    x, w = ins[0], ins[1].astype(ins[0].dtype)  # bf16 policy: act dtype
     nd = x.ndim - 2
     kernel, stride, pad, dilate = _conv_geometry(attrs, nd)
     adj = parse_tuple(attrs.get("adj") or (0,) * nd, nd)
@@ -217,7 +227,7 @@ def _deconvolution(ins, attrs, ctx):
         dimension_numbers=_CONV_DIMNUMS[nd],
         feature_group_count=num_group)
     if len(ins) > 2:
-        y = y + ins[2].reshape((1, -1) + (1,) * nd)
+        y = y + ins[2].astype(y.dtype).reshape((1, -1) + (1,) * nd)
     return y
 
 

@@ -131,6 +131,20 @@ def test_train_transformer_lm_moe():
         and "done" in out
 
 
+def test_train_fcn_seg():
+    """The FCN family (reference example/fcn-xs): Deconvolution
+    upsampling + per-pixel SoftmaxOutput(multi_output) learns the
+    synthetic shape-segmentation task."""
+    out = _run("train_fcn_seg.py", "--num-epochs", "5",
+               "--num-batches", "6")
+    assert "pixel-accuracy" in out and "done" in out
+    import re
+
+    accs = [float(m) for m in re.findall(r"pixel-accuracy=([0-9.]+)",
+                                         out)]
+    assert accs[-1] > 0.8, accs
+
+
 def test_train_neural_style():
     """The neural-style family (reference example/neural-style):
     gradients flow to the INPUT image (attach_grad on a non-parameter)
