@@ -83,6 +83,9 @@ def main():
     ap.add_argument("--lr", type=float, default=2e-3)
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
+    if args.size < 20:
+        ap.error("--size must be >= 20 (shapes are drawn with centers "
+                 "in [8, size-8))")
 
     B, S = args.batch_size, args.size
     rng = np.random.RandomState(0)

@@ -9,7 +9,9 @@ it and restores onto the step's shardings, so checkpoints scale with
 the mesh (the standard jax production pattern).
 
 State saved: params, optimizer states, aux (BN moving stats), and
-``num_update`` — everything `FusedTrainStep` needs to resume bit-exact.
+``num_update`` — everything `FusedTrainStep` (or, via its
+stage-stacked flat buffers, `SymbolPipelineTrainStep`) needs to
+resume bit-exact.
 """
 from __future__ import annotations
 
@@ -20,6 +22,15 @@ __all__ = ["save_sharded", "restore_sharded"]
 
 
 def _state_dict(step) -> Dict[str, Any]:
+    if hasattr(step, "flat_params"):
+        # SymbolPipelineTrainStep: stage-stacked flat buffers
+        return {
+            "flat_params": step.flat_params,
+            "opt_states": list(step.opt_states),
+            "flat_aux": step.flat_aux,
+            "num_update": step.num_update,
+            "rng_key": step._key,
+        }
     return {
         "params": dict(step.params),
         "opt_states": {k: list(v) for k, v in step.opt_states.items()},
@@ -56,6 +67,13 @@ def restore_sharded(path: str, step) -> None:
         _state_dict(step))
     with ocp.StandardCheckpointer() as ckpt:
         state = ckpt.restore(path, template)
+    if hasattr(step, "flat_params"):
+        step.flat_params = state["flat_params"]
+        step.opt_states = tuple(state["opt_states"])
+        step.flat_aux = state["flat_aux"]
+        step.num_update = int(state["num_update"])
+        step._key = state["rng_key"]
+        return
     step.params = dict(state["params"])
     step.opt_states = {k: tuple(v)
                        for k, v in state["opt_states"].items()}
