@@ -28,7 +28,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def lm_train_step_flops(batch, seq, embed, layers, vocab,
-                        causal_skips_masked=False):
+                        causal_skips_masked=False, moe_experts=0,
+                        moe_top_k=2, moe_capacity=1.25):
     """Model FLOPs for ONE training step (fwd + bwd = 3× fwd matmul
     FLOPs; backward re-derives both dX and dW for every matmul).
 
@@ -42,9 +43,18 @@ def lm_train_step_flops(batch, seq, embed, layers, vocab,
       number, and the caller must assert which kernel actually runs.
     - head: 2·N·E·V
     Embedding gathers are not matmul FLOPs and are excluded.
+
+    ``moe_experts``: the dense FFN term is replaced by the EXECUTED
+    expert work — the (E, cap, d) capacity buffers are computed in
+    full (padding slots included), so executed FFN FLOPs scale by
+    capacity_factor × top_k, plus the router matmul.
     """
     n = batch * seq
-    proj = 24.0 * n * embed * embed * layers
+    ffn = 16.0 * n * embed * embed * layers
+    if moe_experts:
+        ffn = ffn * moe_capacity * moe_top_k \
+            + 2.0 * n * embed * moe_experts * layers  # router
+    proj = 8.0 * n * embed * embed * layers + ffn
     att = 4.0 * batch * seq * seq * embed * layers
     if causal_skips_masked:
         att /= 2.0
@@ -87,10 +97,12 @@ def run(defaults=None):
         heads = next(h for h in range(max(1, E // 128), 0, -1)
                      if E % h == 0)
     fused_qkv = os.environ.get("TP_LM_FUSED_QKV") == "1"
+    moe = int(cfg("TP_LM_MOE", 0))  # experts per layer; 0 = dense FFN
+    moe_k = int(cfg("TP_LM_MOE_TOPK", 2))
     net = mx.models.transformer_lm(
         vocab_size=V, embed=E, heads=heads,
         num_layers=L, seq_len=S, batch_size=B, dtype=dtype, head=head,
-        fused_qkv=fused_qkv)
+        fused_qkv=fused_qkv, moe_experts=moe, moe_top_k=moe_k)
     step = parallel.FusedTrainStep(
         net, {"data": (B, S)}, {"softmax_label": (B, S)},
         mesh=parallel.default_mesh(1), optimizer="adam",
@@ -122,14 +134,18 @@ def run(defaults=None):
     att_shape = (B, heads, S, E // heads)
     flash = flash_eligible(att_shape, att_shape)
     step_flops = lm_train_step_flops(B, S, E, L, V,
-                                     causal_skips_masked=flash)
+                                     causal_skips_masked=flash,
+                                     moe_experts=moe, moe_top_k=moe_k)
     tflops = step_flops * steps / dt / 1e12
+    rec_extra = {}
+    if moe:
+        rec_extra = {"moe_experts": moe, "moe_top_k": moe_k}
     return {
         "metric": "transformer_lm_train_tokens_per_sec",
         "value": round(B * S * steps / dt, 1),
         "unit": "tokens/s",
         "batch": B, "seq_len": S, "embed": E, "layers": L,
-        "vocab": V, "dtype": dtype, "head": head,
+        "vocab": V, "dtype": dtype, "head": head, **rec_extra,
         # config provenance: env can override any knob, so the record
         # states what ACTUALLY ran (a "tuned" label alone could lie)
         "opt_state_dtype": cfg("TP_LM_OPT_DTYPE", "") or "float32",
