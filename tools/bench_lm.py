@@ -10,8 +10,11 @@ sustained matmul rate and its nominal peak.
 
 Env: TP_LM_BATCH (8), TP_LM_SEQ (2048), TP_LM_EMBED (512),
 TP_LM_LAYERS (4), TP_LM_VOCAB (32000), TP_LM_STEPS (10),
-TP_LM_DTYPE (bfloat16), TP_LM_HEAD (fused|softmax), TP_LM_SMALL=1
-(CPU smoke), TP_SUSTAINED_TFLOPS (154, PERF.md §10),
+TP_LM_DTYPE (bfloat16), TP_LM_HEAD (fused|softmax),
+TP_LM_OPT_DTYPE / TP_LM_GRAD_DTYPE (bf16 opt-ins, PERF.md §21b),
+TP_LM_MOE (experts per layer, 0 = dense) / TP_LM_MOE_TOPK (2) /
+TP_LM_MOE_CAP (1.25) — the MoE model family (PERF.md §8e),
+TP_LM_SMALL=1 (CPU smoke), TP_SUSTAINED_TFLOPS (154, PERF.md §10),
 TP_PEAK_TFLOPS (197, v5e bf16 nominal).
 """
 from __future__ import annotations
@@ -98,11 +101,15 @@ def run(defaults=None):
                      if E % h == 0)
     fused_qkv = os.environ.get("TP_LM_FUSED_QKV") == "1"
     moe = int(cfg("TP_LM_MOE", 0))  # experts per layer; 0 = dense FFN
-    moe_k = int(cfg("TP_LM_MOE_TOPK", 2))
+    # clamp like the kernel does (contrib_ops k = min(top_k, E)) so the
+    # FLOPs count can never exceed the executed work
+    moe_k = min(int(cfg("TP_LM_MOE_TOPK", 2)), moe) if moe else 2
+    moe_cap = float(cfg("TP_LM_MOE_CAP", 1.25))
     net = mx.models.transformer_lm(
         vocab_size=V, embed=E, heads=heads,
         num_layers=L, seq_len=S, batch_size=B, dtype=dtype, head=head,
-        fused_qkv=fused_qkv, moe_experts=moe, moe_top_k=moe_k)
+        fused_qkv=fused_qkv, moe_experts=moe, moe_top_k=moe_k,
+        moe_capacity=moe_cap)
     step = parallel.FusedTrainStep(
         net, {"data": (B, S)}, {"softmax_label": (B, S)},
         mesh=parallel.default_mesh(1), optimizer="adam",
@@ -135,11 +142,13 @@ def run(defaults=None):
     flash = flash_eligible(att_shape, att_shape)
     step_flops = lm_train_step_flops(B, S, E, L, V,
                                      causal_skips_masked=flash,
-                                     moe_experts=moe, moe_top_k=moe_k)
+                                     moe_experts=moe, moe_top_k=moe_k,
+                                     moe_capacity=moe_cap)
     tflops = step_flops * steps / dt / 1e12
     rec_extra = {}
     if moe:
-        rec_extra = {"moe_experts": moe, "moe_top_k": moe_k}
+        rec_extra = {"moe_experts": moe, "moe_top_k": moe_k,
+                     "moe_capacity": moe_cap}
     return {
         "metric": "transformer_lm_train_tokens_per_sec",
         "value": round(B * S * steps / dt, 1),
