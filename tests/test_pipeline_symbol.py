@@ -256,3 +256,39 @@ def test_resnet_pipelines_exactly():
     for n, v in fused.params.items():
         np.testing.assert_allclose(np.asarray(v), got[n].asnumpy(),
                                    rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_input_reentry_in_later_stage_uses_correct_microbatch():
+    """A net whose INPUT is consumed again past the first cut: the
+    later stage must read the microbatch its in-flight activation came
+    from (slot = t - s), not tick t's — float-exact vs the oracle."""
+    d = mx.sym.Variable("data")
+    x = d
+    for i in range(4):
+        x = mx.sym.FullyConnected(x, num_hidden=16, name="fc%d" % i)
+        x = mx.sym.Activation(x, act_type="relu", name="r%d" % i)
+    x = mx.sym.Concat(x, d, dim=1, name="skip_in")  # data re-enters
+    x = mx.sym.FullyConnected(x, num_hidden=5, name="out")
+    net = mx.sym.SoftmaxOutput(x, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+    fused = parallel.FusedTrainStep(
+        net, {"data": (8, 12)}, {"softmax_label": (8,)},
+        mesh=parallel.default_mesh(1), optimizer="sgd",
+        optimizer_params={"learning_rate": 0.5},
+        initializer=mx.initializer.Xavier(), seed=0, grad_accum=4)
+    pp = SymbolPipelineTrainStep(
+        net, {"data": (8, 12)}, {"softmax_label": (8,)},
+        mesh=parallel.build_mesh({"pp": 2}), num_microbatches=4,
+        optimizer="sgd", optimizer_params={"learning_rate": 0.5},
+        initializer=mx.initializer.Xavier(), seed=0)
+    assert any("skip_in" in s for s in pp.stage_assignment[1:])
+    pp.set_params({n: np.asarray(v) for n, v in fused.params.items()})
+    rng = np.random.RandomState(0)
+    batch = _batch(rng, {"data": (8, 12), "softmax_label": (8,)})
+    for _ in range(3):
+        fused(batch)
+        pp(batch)
+    got = pp.get_params()
+    for n, v in fused.params.items():
+        np.testing.assert_allclose(np.asarray(v), got[n].asnumpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
