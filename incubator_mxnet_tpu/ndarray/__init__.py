@@ -145,27 +145,26 @@ def _ctx_put(arr, ctx: Optional[Context]):
 
 
 def zeros(shape, ctx=None, dtype=None, **kwargs):
-    import jax.numpy as jnp
-
+    # host numpy + ONE device_put, never jnp.zeros: the device route
+    # compiles an XLA program per unique shape over the tunnel
+    # (seconds each on a bad-weather day) and, when ctx differs from
+    # the default device, round-trips the buffer through the ~5 MB/s
+    # D2H path (PERF.md §1) — constant-fill creation belongs on host
     if isinstance(shape, int):
         shape = (shape,)
-    return _ctx_put(jnp.zeros(shape, dtype=dtype_np(dtype)), ctx)
+    return _ctx_put(np.zeros(shape, dtype_np(dtype)), ctx)
 
 
 def ones(shape, ctx=None, dtype=None, **kwargs):
-    import jax.numpy as jnp
-
     if isinstance(shape, int):
         shape = (shape,)
-    return _ctx_put(jnp.ones(shape, dtype=dtype_np(dtype)), ctx)
+    return _ctx_put(np.ones(shape, dtype_np(dtype)), ctx)
 
 
 def full(shape, val, ctx=None, dtype=None, **kwargs):
-    import jax.numpy as jnp
-
     if isinstance(shape, int):
         shape = (shape,)
-    return _ctx_put(jnp.full(shape, val, dtype=dtype_np(dtype)), ctx)
+    return _ctx_put(np.full(shape, val, dtype_np(dtype)), ctx)
 
 
 def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
