@@ -290,11 +290,13 @@ class Executor:
     # ----------------------------------------------------------- construction
     @staticmethod
     def _alloc(shape, dtype, ctx: Context) -> NDArray:
-        import jax
-        import jax.numpy as jnp
+        # THE host-create + single-put path (ndarray.zeros): on-device
+        # creation would compile per shape and drag the buffer through
+        # the ~5 MB/s D2H tunnel for any non-default ctx (measured:
+        # 88 s to bind ResNet-50 with cpu-ctx executors)
+        from .ndarray import zeros as nd_zeros
 
-        return NDArray(jax.device_put(jnp.zeros(shape, dtype=dtype),
-                                      ctx.jax_device), ctx=ctx)
+        return nd_zeros(shape, ctx=ctx, dtype=dtype)
 
     @classmethod
     def _simple_bind(cls, symbol, ctx, grad_req, type_dict, group2ctx,

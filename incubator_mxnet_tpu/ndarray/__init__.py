@@ -168,13 +168,13 @@ def full(shape, val, ctx=None, dtype=None, **kwargs):
 
 
 def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
-    import jax.numpy as jnp
-
+    # host numpy like zeros(): the jnp route compiles an iota program
+    # per unique length on the default device and migrates cross-ctx
     if stop is None:
         start, stop = 0, start
-    out = jnp.arange(start, stop, step, dtype=dtype_np(dtype))
+    out = np.arange(start, stop, step, dtype=dtype_np(dtype))
     if repeat > 1:
-        out = jnp.repeat(out, repeat)
+        out = np.repeat(out, repeat)
     return _ctx_put(out, ctx)
 
 

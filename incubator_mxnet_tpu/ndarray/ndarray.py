@@ -237,6 +237,27 @@ class NDArray:
 
         if isinstance(value, NDArray):
             value = value.data
+        if isinstance(key, slice) and key == slice(None) \
+                and (isinstance(value, (int, float))
+                     or (isinstance(value, np.ndarray)
+                         and tuple(value.shape) == tuple(self.shape))):
+            # full-buffer host assignment (array OR scalar fill) lands
+            # straight on THIS array's device: jnp.asarray/jnp.full
+            # would materialize on the DEFAULT device — a per-shape
+            # compile over the tunnel plus a migration through the
+            # ~5 MB/s D2H path for any other ctx.  The initializer
+            # zoo's `arr[:] = 0.0` BN fills alone cost ~20 s of
+            # round-trips per ResNet-50 before this (PERF.md §1)
+            import jax
+
+            host = np.full(self.shape, value,
+                           np.dtype(self.data.dtype)) \
+                if isinstance(value, (int, float)) \
+                else np.asarray(value, dtype=np.dtype(self.data.dtype))
+            self._set_data(jax.device_put(
+                host,
+                self._ctx.jax_device if self._ctx is not None else None))
+            return
         if isinstance(value, (int, float)):
             pass
         else:
