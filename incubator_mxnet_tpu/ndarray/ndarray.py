@@ -458,12 +458,13 @@ def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
 
 def empty(shape, ctx: Optional[Context] = None, dtype=None) -> NDArray:
     import jax
-    import jax.numpy as jnp
 
+    # host numpy + one put, like zeros() (see ndarray/__init__.py):
+    # on-device creation compiles per shape and migrates cross-ctx
     ctx = ctx or current_context()
     return NDArray(jax.device_put(
-        jnp.zeros(shape if isinstance(shape, (tuple, list)) else (shape,),
-                  dtype=dtype_np(dtype)), ctx.jax_device), ctx=ctx)
+        np.zeros(shape if isinstance(shape, (tuple, list)) else (shape,),
+                 dtype_np(dtype)), ctx.jax_device), ctx=ctx)
 
 
 def waitall() -> None:
