@@ -228,7 +228,11 @@ def save(fname: str, data) -> None:
         names, arrays = list(data.keys()), list(data.values())
     else:
         names, arrays = [], list(data)
-    with open(fname, "wb") as f:
+    from ..filesystem import open_uri
+
+    # open_uri gives remote URIs the clear "read-only" diagnostic
+    # instead of a baffling FileNotFoundError on 's3:/...'
+    with open_uri(fname, "wb") as f:
         f.write(struct.pack("<QQ", _NDARRAY_MAGIC, 0))
         f.write(struct.pack("<Q", len(arrays)))
         for arr in arrays:
@@ -281,8 +285,13 @@ def _load_one_reference(f):
 def load(fname: str):
     """Load dict/list of NDArrays (``MXNDArrayLoad``) — genuine
     reference files (incl. pre-0.9 shape framing) and this repo's
-    round-3 container."""
-    with open(fname, "rb") as f:
+    round-3 container.  Accepts stream URIs (http/s3/hdfs) like the
+    reference's dmlc Stream path (``ndarray.cc`` Load over
+    ``Stream::Create``) — checkpoints pull straight from object
+    stores."""
+    from ..filesystem import open_uri
+
+    with open_uri(fname, "rb") as f:
         magic, word2 = struct.unpack("<QQ", f.read(16))
         if magic != _NDARRAY_MAGIC:
             raise MXNetError("invalid NDArray file %s" % fname)

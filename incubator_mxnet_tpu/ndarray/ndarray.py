@@ -457,14 +457,10 @@ def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
 
 
 def empty(shape, ctx: Optional[Context] = None, dtype=None) -> NDArray:
-    import jax
+    # delegate to THE constant-fill path (host numpy + one device_put)
+    from . import zeros as nd_zeros
 
-    # host numpy + one put, like zeros() (see ndarray/__init__.py):
-    # on-device creation compiles per shape and migrates cross-ctx
-    ctx = ctx or current_context()
-    return NDArray(jax.device_put(
-        np.zeros(shape if isinstance(shape, (tuple, list)) else (shape,),
-                 dtype_np(dtype)), ctx.jax_device), ctx=ctx)
+    return nd_zeros(shape, ctx=ctx, dtype=dtype)
 
 
 def waitall() -> None:

@@ -554,5 +554,12 @@ def load_json(json_str: str) -> Symbol:
 
 
 def load(fname: str) -> Symbol:
-    with open(fname) as f:
-        return load_json(f.read())
+    # stream-URI dispatch like nd.load (the reference's Symbol::Load
+    # went through dmlc Stream::Create too) — checkpoints pull whole
+    # from http/s3/hdfs
+    from ..filesystem import open_uri
+
+    with open_uri(fname, "rb") as f:
+        data = f.read()
+    return load_json(data.decode("utf-8")
+                     if isinstance(data, bytes) else data)

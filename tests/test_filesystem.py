@@ -200,3 +200,18 @@ def test_image_record_iter_over_http(tmp_path, http_root):
     batches = list(it)
     assert len(batches) == 2
     assert batches[0].data[0].shape == (4, 3, 32, 32)
+
+
+def test_nd_load_over_http(tmp_path, http_root):
+    """mx.nd.load reads a .params blob from a remote URI (the
+    reference's dmlc-Stream checkpoint-from-S3 capability row)."""
+    import incubator_mxnet_tpu as mx
+
+    d = {"w": mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4)),
+         "b": mx.nd.ones((5,))}
+    mx.nd.save("weights.params", d)  # saved into the served dir
+    base, _ = http_root
+    back = mx.nd.load(base + "/weights.params")
+    assert set(back) == {"w", "b"}
+    np.testing.assert_array_equal(back["w"].asnumpy(),
+                                  d["w"].asnumpy())
