@@ -131,6 +131,15 @@ def test_train_transformer_lm_moe():
         and "done" in out
 
 
+def test_train_bayesian_sgld():
+    """The Bayesian-methods family (reference example/bayesian-methods):
+    SGLD posterior sampling; the posterior-mean prediction must hold up
+    (asserted inside the driver)."""
+    out = _run("train_bayesian_sgld.py", "--num-epochs", "24",
+               "--burn-in", "12")
+    assert "posterior-mean" in out and "done" in out
+
+
 def test_train_fcn_seg():
     """The FCN family (reference example/fcn-xs): Deconvolution
     upsampling + per-pixel SoftmaxOutput(multi_output) learns the
