@@ -59,6 +59,9 @@ def main():
                     help="Gaussian prior precision (SGLD's weight decay)")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
+    if args.num_epochs <= args.burn_in:
+        ap.error("--num-epochs must exceed --burn-in (no posterior "
+                 "samples would be collected)")
 
     rng = np.random.RandomState(0)
     xtr, ytr = make_data(rng, args.num_train)
@@ -76,16 +79,11 @@ def main():
     from incubator_mxnet_tpu.io import DataBatch
 
     def predict_probs(x):
-        out = []
-        for b in range(0, len(x), B):
-            xb = x[b:b + B]
-            pad = B - len(xb)
-            if pad:
-                xb = np.concatenate([xb, np.zeros((pad, 2), np.float32)])
-            mod.forward(DataBatch([mx.nd.array(xb)], []),
-                        is_train=False)
-            out.append(mod.get_outputs()[0].asnumpy()[:B - pad])
-        return np.concatenate(out)
+        # the library iterator pads the last batch and predict()
+        # strips it — no hand-rolled batching
+        it = mx.io.NDArrayIter(x, batch_size=B,
+                               last_batch_handle="pad")
+        return mod.predict(it).asnumpy()[:len(x)]
 
     posterior = np.zeros((args.num_test, 2), np.float64)
     n_samples = 0
@@ -97,12 +95,16 @@ def main():
             mod.forward_backward(DataBatch([mx.nd.array(xtr[sl])],
                                            [mx.nd.array(ytr[sl])]))
             mod.update()
+        probs = None
         if epoch >= args.burn_in:
             # this parameter snapshot IS a posterior sample
-            posterior += predict_probs(xte)
+            probs = predict_probs(xte)
+            posterior += probs
             n_samples += 1
         if (epoch + 1) % 5 == 0:
-            acc = (predict_probs(xte).argmax(1) == yte).mean()
+            if probs is None:
+                probs = predict_probs(xte)
+            acc = (probs.argmax(1) == yte).mean()
             logging.info("Epoch[%d] sample-accuracy=%.4f", epoch, acc)
 
     single = (predict_probs(xte).argmax(1) == yte).mean()
