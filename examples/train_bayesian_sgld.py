@@ -86,6 +86,7 @@ def main():
         return mod.predict(it).asnumpy()[:len(x)]
 
     posterior = np.zeros((args.num_test, 2), np.float64)
+    sample_accs = []
     n_samples = 0
     nb = args.num_train // B
     for epoch in range(args.num_epochs):
@@ -100,6 +101,7 @@ def main():
             # this parameter snapshot IS a posterior sample
             probs = predict_probs(xte)
             posterior += probs
+            sample_accs.append((probs.argmax(1) == yte).mean())
             n_samples += 1
         if (epoch + 1) % 5 == 0:
             if probs is None:
@@ -107,14 +109,16 @@ def main():
             acc = (probs.argmax(1) == yte).mean()
             logging.info("Epoch[%d] sample-accuracy=%.4f", epoch, acc)
 
-    single = (predict_probs(xte).argmax(1) == yte).mean()
+    mean_sample = float(np.mean(sample_accs))
     bayes = ((posterior / n_samples).argmax(1) == yte).mean()
-    logging.info("last-sample accuracy=%.4f  posterior-mean "
-                 "accuracy=%.4f (%d samples)", single, bayes, n_samples)
+    logging.info("mean single-sample accuracy=%.4f  posterior-mean "
+                 "accuracy=%.4f (%d samples)", mean_sample, bayes,
+                 n_samples)
     # the Bayesian average must solve the task AND not lose to the
-    # (noisy) single SGLD sample — the property the sampler exists for
+    # AVERAGE single sample (individual SGLD samples are noisy by
+    # design — comparing against one would be a coin flip)
     assert bayes >= 0.80, bayes
-    assert bayes >= single - 0.02, (bayes, single)
+    assert bayes >= mean_sample - 0.02, (bayes, mean_sample)
     print("done")
     return 0
 
