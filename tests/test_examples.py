@@ -131,6 +131,14 @@ def test_train_transformer_lm_moe():
         and "done" in out
 
 
+def test_train_ctc_seq():
+    """The warpctc family (reference example/warpctc): LSTM + CTCLoss
+    learns unsegmented digit sequences to >0.7 exact-match (asserted
+    inside the driver)."""
+    out = _run("train_ctc_seq.py")  # defaults: converges to ~0.98
+    assert "seq-accuracy" in out and "done" in out
+
+
 def test_train_bayesian_sgld():
     """The Bayesian-methods family (reference example/bayesian-methods):
     SGLD posterior sampling; the posterior-mean prediction must hold up
