@@ -223,3 +223,11 @@ def test_train_bi_lstm_sort():
     assert "final-acc=" in out
     acc = float(out.rsplit("final-acc=", 1)[1].split()[0])
     assert acc > 0.5, acc  # chance is 1/16; bidirectional context needed
+
+
+def test_train_custom_op():
+    """The numpy-ops family (reference example/numpy-ops): a python
+    CustomOp loss layer trains a real Module loop (>0.9 accuracy
+    asserted inside the driver)."""
+    out = _run("train_custom_op.py")
+    assert "Train-accuracy" in out and "done" in out
