@@ -80,6 +80,8 @@ def main():
     ap.add_argument("--lr", type=float, default=1.0)
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
+    if args.num_examples < args.batch_size:
+        ap.error("--num-examples must be >= --batch-size")
 
     rng = np.random.RandomState(0)
     W = rng.randn(16, 10)
