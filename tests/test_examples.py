@@ -231,3 +231,13 @@ def test_train_custom_op():
     asserted inside the driver)."""
     out = _run("train_custom_op.py")
     assert "Train-accuracy" in out and "done" in out
+
+
+def test_train_svm_mnist():
+    """The svm_mnist family (reference example/svm_mnist): SVMOutput
+    hinge heads — both L2 (squared hinge) and L1 (use_linear) — train
+    to >0.9 accuracy (asserted inside the driver)."""
+    out = _run("train_svm_mnist.py")
+    assert "Train-accuracy" in out and "done" in out
+    out = _run("train_svm_mnist.py", "--use-linear")
+    assert "done" in out
