@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Model-parallel LSTM language model: each layer on its own device.
+
+Reference family: ``example/model-parallel-lstm`` (``lstm.py:65-68``
+pins every time-step cell of layer *l* into ctx group ``layer%d`` and
+binds with a group2ctx map, so a deep unrolled LSTM whose parameters
+don't fit one device spreads layer-wise across several).  This driver
+exercises the same capability on the TPU-native stack: the unrolled
+symbol is built with ``mx.AttrScope(ctx_group=...)`` annotations and
+bound through ``simple_bind(group2ctx=...)`` — the lowered XLA program
+spans the group devices, with cross-device copies at layer boundaries
+(``lowering.py:lower_symbol_grouped``, the graph_executor.cc:279-393
+AssignContext analog).
+
+Zero-egress: trains on a synthetic deterministic-chain corpus
+(next token = (3*t + 1) mod V), so falling perplexity is checkable.
+On the single-TPU session all groups map to the one chip (placement is
+still exercised end-to-end); under ``TP_EXAMPLES_CPU_DEVICES=N`` the
+layers genuinely land on N distinct devices.
+"""
+import argparse
+import logging
+import math
+
+import numpy as np
+
+import common  # noqa: F401  (path setup + TP_EXAMPLES_FORCE_CPU)
+import incubator_mxnet_tpu as mx
+
+
+def lstm_cell(num_hidden, indata, prev_c, prev_h, param, layeridx, seqidx):
+    """One LSTM step sharing layer ``param`` across timesteps."""
+    i2h = mx.sym.FullyConnected(data=indata, weight=param["i2h_weight"],
+                                bias=param["i2h_bias"],
+                                num_hidden=num_hidden * 4,
+                                name="l%d_t%d_i2h" % (layeridx, seqidx))
+    h2h = mx.sym.FullyConnected(data=prev_h, weight=param["h2h_weight"],
+                                bias=param["h2h_bias"],
+                                num_hidden=num_hidden * 4,
+                                name="l%d_t%d_h2h" % (layeridx, seqidx))
+    gates = mx.sym.SliceChannel(i2h + h2h, num_outputs=4, axis=1,
+                                name="l%d_t%d_gates" % (layeridx, seqidx))
+    in_gate = mx.sym.Activation(gates[0], act_type="sigmoid")
+    in_trans = mx.sym.Activation(gates[1], act_type="tanh")
+    forget = mx.sym.Activation(gates[2], act_type="sigmoid")
+    out_gate = mx.sym.Activation(gates[3], act_type="sigmoid")
+    next_c = forget * prev_c + in_gate * in_trans
+    next_h = out_gate * mx.sym.Activation(next_c, act_type="tanh")
+    return next_c, next_h
+
+
+def build_unrolled(num_layers, seq_len, vocab, num_embed, num_hidden):
+    """Unrolled LSTM LM with layer-wise ctx groups.
+
+    Layer *l*'s cells and parameters all carry ``ctx_group='layer<l>'``;
+    the embedding rides with layer 0 and the decoder with the last
+    layer (the reference's placement, ``lstm.py:151-163``).
+    """
+    data = mx.sym.Variable("data")          # (batch, seq_len) int ids
+    label = mx.sym.Variable("softmax_label")
+    with mx.AttrScope(ctx_group="layer0"):
+        embed_weight = mx.sym.Variable("embed_weight")
+        embed = mx.sym.Embedding(data=data, weight=embed_weight,
+                                 input_dim=vocab, output_dim=num_embed,
+                                 name="embed")
+        steps = mx.sym.SliceChannel(embed, num_outputs=seq_len, axis=1,
+                                    squeeze_axis=1, name="step_slices")
+
+    params, states = [], []
+    for l in range(num_layers):
+        with mx.AttrScope(ctx_group="layer%d" % l):
+            params.append({
+                k: mx.sym.Variable("l%d_%s" % (l, k))
+                for k in ("i2h_weight", "i2h_bias",
+                          "h2h_weight", "h2h_bias")})
+            states.append((mx.sym.Variable("l%d_init_c" % l),
+                           mx.sym.Variable("l%d_init_h" % l)))
+
+    hidden_all = []
+    for t in range(seq_len):
+        hidden = steps[t]
+        for l in range(num_layers):
+            with mx.AttrScope(ctx_group="layer%d" % l):
+                c, h = lstm_cell(num_hidden, hidden, states[l][0],
+                                 states[l][1], params[l], l, t)
+            states[l] = (c, h)
+            hidden = h
+        hidden_all.append(hidden)
+
+    with mx.AttrScope(ctx_group="layer%d" % (num_layers - 1)):
+        concat = mx.sym.Concat(*hidden_all, dim=0, name="seq_concat")
+        pred = mx.sym.FullyConnected(data=concat, num_hidden=vocab,
+                                     name="decoder")
+        # label arrives (batch, seq_len): to match the (seq major) concat
+        # rows we transpose before flattening
+        flat_label = mx.sym.Reshape(mx.sym.transpose(label, axes=(1, 0)),
+                                    shape=(-1,))
+        sm = mx.sym.SoftmaxOutput(data=pred, label=flat_label,
+                                  name="softmax")
+    return sm
+
+
+def chain_corpus(num_batches, batch_size, seq_len, vocab, seed=0):
+    """Deterministic-chain batches: t_{k+1} = (3 t_k + 1) mod vocab."""
+    rng = np.random.RandomState(seed)
+    for _ in range(num_batches):
+        start = rng.randint(0, vocab, size=(batch_size, 1))
+        seq = [start]
+        for _ in range(seq_len):
+            seq.append((3 * seq[-1] + 1) % vocab)
+        seq = np.concatenate(seq, axis=1)
+        yield seq[:, :seq_len].astype(np.float32), \
+            seq[:, 1:seq_len + 1].astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="model-parallel LSTM LM (layer-per-device group2ctx)")
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-hidden", type=int, default=64)
+    p.add_argument("--num-embed", type=int, default=32)
+    p.add_argument("--vocab-size", type=int, default=16)
+    p.add_argument("--seq-len", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--num-batches", type=int, default=40)
+    p.add_argument("--num-epochs", type=int, default=2)
+    p.add_argument("--lr", type=float, default=0.5)
+    p.add_argument("--disp-batches", type=int, default=10)
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+
+    import jax
+
+    devices = [mx.Context("cpu" if d.platform == "cpu" else "gpu", i)
+               for i, d in enumerate(jax.devices())]
+    group2ctx = {"layer%d" % l: devices[l % len(devices)]
+                 for l in range(args.num_layers)}
+    logging.info("placement: %s",
+                 {g: str(c) for g, c in group2ctx.items()})
+
+    sym = build_unrolled(args.num_layers, args.seq_len, args.vocab_size,
+                         args.num_embed, args.num_hidden)
+    input_names = {"data", "softmax_label"}
+    state_names = {n for n in sym.list_arguments() if "_init_" in n}
+    grad_req = {n: ("null" if n in input_names or n in state_names
+                    else "write") for n in sym.list_arguments()}
+    exe = sym.simple_bind(
+        devices[0], grad_req=grad_req, group2ctx=group2ctx,
+        data=(args.batch_size, args.seq_len),
+        softmax_label=(args.batch_size, args.seq_len),
+        **{("l%d_init_%s" % (l, s)): (args.batch_size, args.num_hidden)
+           for l in range(args.num_layers) for s in "ch"})
+
+    init = mx.initializer.Xavier(factor_type="in", magnitude=2.34)
+    for n, arr in exe.arg_dict.items():
+        if grad_req[n] == "write":
+            init(mx.initializer.InitDesc(n), arr)
+
+    opt = mx.optimizer.create("sgd", learning_rate=args.lr, momentum=0.9,
+                              wd=1e-5,
+                              rescale_grad=1.0 / (args.batch_size *
+                                                  args.seq_len))
+    updater = mx.optimizer.get_updater(opt)
+    trainables = [n for n in sym.list_arguments()
+                  if grad_req[n] == "write"]
+
+    for epoch in range(args.num_epochs):
+        nll, count = 0.0, 0
+        for i, (d, lbl) in enumerate(chain_corpus(
+                args.num_batches, args.batch_size, args.seq_len,
+                args.vocab_size, seed=epoch)):
+            exe.arg_dict["data"][:] = d
+            exe.arg_dict["softmax_label"][:] = lbl
+            exe.forward(is_train=True)
+            exe.backward()
+            for k, n in enumerate(trainables):
+                updater(k, exe.grad_dict[n], exe.arg_dict[n])
+            prob = exe.outputs[0].asnumpy()  # (seq*batch, vocab) seq-major
+            flat = lbl.T.reshape(-1).astype(np.int64)
+            nll -= np.sum(np.log(np.maximum(
+                prob[np.arange(flat.size), flat], 1e-10)))
+            count += flat.size
+            if (i + 1) % args.disp_batches == 0:
+                logging.info("epoch %d batch %d perplexity=%.3f",
+                             epoch, i + 1, math.exp(nll / count))
+        logging.info("Epoch[%d] Train-perplexity=%.3f",
+                     epoch, math.exp(nll / count))
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -179,6 +179,37 @@ def test_train_word2vec_nce():
     assert "nce-accuracy=1.0000" in out and "done" in out
 
 
+def test_train_model_parallel_lstm():
+    """The model-parallel-lstm family (reference
+    example/model-parallel-lstm): each unrolled LSTM layer pinned to its
+    own device via AttrScope(ctx_group)+group2ctx; the deterministic
+    chain task must be learned (perplexity well under the vocab=16
+    uniform level)."""
+    out = _run("train_model_parallel_lstm.py", "--num-epochs", "2",
+               "--num-batches", "20", n_devices=2)
+    assert "'layer1': 'cpu(1)'" in out and "done" in out
+    import re
+
+    ppl = [float(m) for m in re.findall(r"Train-perplexity=([0-9.]+)",
+                                        out)]
+    assert ppl[-1] < 10.0, ppl
+
+
+def test_train_rl_actor_critic():
+    """The reinforcement-learning family (reference
+    example/reinforcement-learning/parallel_actor_critic): batched
+    multi-env rollouts + GAE + one A2C forward/backward per update on
+    the built-in CartPole; the policy must clearly beat the ~20-step
+    random baseline."""
+    out = _run("train_rl_actor_critic.py", "--updates", "100",
+               "--disp", "50")
+    assert "done" in out
+    import re
+
+    final = re.search(r"final mean-episode-length=([0-9.]+)", out)
+    assert final and float(final.group(1)) > 60.0, out[-500:]
+
+
 def test_train_dcgan():
     out = _run("train_dcgan.py", "--num-epochs", "1",
                "--num-batches", "2", "--size", "32")
