@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Stochastic-depth training: randomly skip residual blocks per batch.
+
+Reference family: ``example/stochastic-depth`` (``sd_module.py``,
+``sd_mnist.py``): each residual block is a two-branch computation — an
+identity skip plus a compute branch that a per-batch Bernoulli gate
+turns OFF with probability ``death_rate`` during training (saving its
+forward AND backward), while prediction adds the compute branch scaled
+by the survival rate (the expectation).  The reference builds this as a
+``BaseModule`` subclass composing two inner ``Module``s inside a
+``SequentialModule`` chain; this driver exercises the same Module
+container surface on the TPU-native stack — per-module executors, the
+``auto_wiring`` output→data renaming, ``take_labels``, external
+gradients through ``backward(out_grads)`` and ``get_input_grads``.
+
+Zero-egress: trains on ``mx.io.MNISTIter``'s deterministic synthetic
+digits, so accuracy is checkable.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import common  # noqa: F401  (path setup + TP_EXAMPLES_FORCE_CPU)
+import incubator_mxnet_tpu as mx
+
+
+class StochasticDepthModule(mx.mod.BaseModule):
+    """Identity skip + randomly gated compute branch (per-batch gate).
+
+    ``forward`` in train mode runs the compute branch only when the
+    gate opens (probability ``1 - death_rate``); in test mode it always
+    runs and its outputs are scaled by the survival rate.  ``backward``
+    adds the compute branch's input grads only for an open gate —
+    exactly the reference module's contract (``sd_module.py:136-170``).
+    """
+
+    def __init__(self, symbol_compute, data_names=("data",),
+                 context=None, death_rate=0.0, seed=0):
+        super(StochasticDepthModule, self).__init__(logger=logging)
+        self._module = mx.mod.Module(symbol_compute,
+                                     data_names=data_names,
+                                     label_names=(),
+                                     context=context or mx.cpu())
+        self._open_rate = 1.0 - death_rate
+        self._rng = np.random.RandomState(seed)
+        self._gate_open = True
+        self._outputs = None
+        self._input_grads = None
+
+    # ---- shape/name surface proxies the inner module -----------------
+    @property
+    def data_names(self):
+        return self._module.data_names
+
+    @property
+    def output_names(self):
+        return self._module.output_names
+
+    @property
+    def data_shapes(self):
+        return self._module.data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._module.label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._module.output_shapes
+
+    def get_params(self):
+        return self._module.get_params()
+
+    def init_params(self, *args, **kwargs):
+        self._module.init_params(*args, **kwargs)
+        self.params_initialized = True
+
+    def bind(self, *args, **kwargs):
+        # the compute branch must always expose input grads: the skip
+        # path needs somewhere to add them
+        kwargs = dict(kwargs)
+        kwargs["inputs_need_grad"] = True
+        self._module.bind(*args, **kwargs)
+        self.binded = True
+        self.for_training = self._module.for_training
+        self.inputs_need_grad = self._module.inputs_need_grad
+
+    def init_optimizer(self, *args, **kwargs):
+        self._module.init_optimizer(*args, **kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self._module.for_training
+        self._skip = [d.copy() for d in data_batch.data]
+        if is_train:
+            self._gate_open = self._rng.rand() < self._open_rate
+            if self._gate_open:
+                self._module.forward(data_batch, is_train=True)
+                self._outputs = [
+                    s + c for s, c in zip(self._skip,
+                                          self._module.get_outputs())]
+            else:
+                self._outputs = self._skip
+        else:
+            self._module.forward(data_batch, is_train=False)
+            self._outputs = [
+                s + self._open_rate * c
+                for s, c in zip(self._skip, self._module.get_outputs())]
+
+    def backward(self, out_grads=None):
+        # identity skip: its input grad IS the output grad
+        self._input_grads = list(out_grads)
+        if self._gate_open:
+            self._module.backward(out_grads=out_grads)
+            self._input_grads = [
+                g + c for g, c in zip(self._input_grads,
+                                      self._module.get_input_grads())]
+
+    def update(self):
+        if self._gate_open:
+            self._module.update()
+
+    def update_metric(self, eval_metric, labels):
+        pass  # no loss head in a residual block
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._input_grads
+
+    def install_monitor(self, mon):
+        self._module.install_monitor(mon)
+
+
+def conv_bn_relu(name, data, num_filter, with_relu=True):
+    conv = mx.sym.Convolution(data=data, num_filter=num_filter,
+                              kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                              no_bias=True, name=name)
+    bn = mx.sym.BatchNorm(data=conv, fix_gamma=False, momentum=0.9,
+                          eps=2e-5, name=name + "_bn")
+    return mx.sym.Activation(bn, act_type="relu") if with_relu else bn
+
+
+def build_modules(num_blocks, num_filter, death_rate, ctx):
+    """Stem module + ``num_blocks`` stochastic residual blocks + head."""
+    seq = mx.mod.SequentialModule()
+    stem = conv_bn_relu("stem", mx.sym.Variable("data"), num_filter)
+    seq.add(mx.mod.Module(stem, label_names=(), context=ctx))
+    for i in range(num_blocks):
+        d = mx.sym.Variable("block%d_data" % i)
+        branch = conv_bn_relu("block%d_a" % i, d, num_filter)
+        branch = conv_bn_relu("block%d_b" % i, branch, num_filter,
+                              with_relu=False)
+        seq.add(StochasticDepthModule(branch,
+                                      data_names=("block%d_data" % i,),
+                                      context=ctx, death_rate=death_rate,
+                                      seed=100 + i),
+                auto_wiring=True)
+    head_in = mx.sym.Variable("head_data")
+    act = mx.sym.Activation(head_in, act_type="relu")
+    pred = mx.sym.FullyConnected(mx.sym.Flatten(act), num_hidden=10)
+    softmax = mx.sym.SoftmaxOutput(pred, name="softmax")
+    seq.add(mx.mod.Module(softmax, data_names=("head_data",),
+                          context=ctx),
+            auto_wiring=True, take_labels=True)
+    return seq
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="stochastic-depth resnet (Module-composition family)")
+    p.add_argument("--num-blocks", type=int, default=2)
+    p.add_argument("--num-filter", type=int, default=8)
+    p.add_argument("--death-rate", type=float, default=0.3)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-examples", type=int, default=512)
+    p.add_argument("--num-epochs", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.05)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+
+    mx.random.seed(0)
+    train = mx.io.MNISTIter(image="absent-train-images",
+                            label="absent-train-labels",
+                            batch_size=args.batch_size, shuffle=True,
+                            num_examples=args.num_examples, seed=0)
+    seq = build_modules(args.num_blocks, args.num_filter,
+                        args.death_rate, mx.cpu())
+    seq.fit(train, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              magnitude=2.34),
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, 8))
+    # a second pass in PREDICTION mode (expectation path: every branch
+    # scaled by the survival rate) must agree with what training reached
+    logging.info("Predict-accuracy=%.4f", seq.score(train, "acc")[0][1])
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -210,6 +210,34 @@ def test_train_rl_actor_critic():
     assert final and float(final.group(1)) > 60.0, out[-500:]
 
 
+def test_train_stochastic_depth():
+    """The stochastic-depth family (reference example/stochastic-depth):
+    residual blocks whose compute branch a per-batch Bernoulli gate
+    skips during training, composed as BaseModule subclasses inside a
+    SequentialModule; the expectation-path prediction must match what
+    training reached."""
+    out = _run("train_stochastic_depth.py")
+    assert "done" in out
+    import re
+
+    acc = re.search(r"Predict-accuracy=([0-9.]+)", out)
+    assert acc and float(acc.group(1)) > 0.9, out[-500:]
+
+
+def test_train_dsd():
+    """The DSD family (reference example/dsd): a user-registered
+    pruning SGD (topk-mask of |w|) trains dense -> sparse -> dense; the
+    sparse phase must actually hold the target sparsity (asserted in
+    the driver) and every phase must stay accurate."""
+    out = _run("train_dsd.py")
+    assert "done" in out and "Sparsity Update" in out
+    import re
+
+    accs = [float(m) for m in
+            re.findall(r"phase \w+: accuracy=([0-9.]+)", out)]
+    assert len(accs) == 3 and min(accs) > 0.9, accs
+
+
 def test_train_dcgan():
     out = _run("train_dcgan.py", "--num-epochs", "1",
                "--num-batches", "2", "--size", "32")
