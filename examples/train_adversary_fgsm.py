@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""FGSM adversarial examples: perturb inputs along the loss gradient.
+
+Reference family: ``example/adversary`` (``adversary_generation.ipynb``):
+train an MNIST classifier, then compute the loss gradient WITH RESPECT
+TO THE INPUT (``inputs_need_grad=True`` binding) and add
+``epsilon * sign(grad)`` — the fast gradient sign method — to
+demonstrate how sharply accuracy collapses under an imperceptible
+perturbation.  Exercises the input-gradient surface of ``Module``
+(``bind(inputs_need_grad=True)`` + ``get_input_grads``) on a trained
+net, plus the ``sign`` op.
+
+Zero-egress: uses ``mx.io.MNISTIter``'s synthetic digits; the driver
+asserts clean accuracy is high and FGSM accuracy collapses.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import common  # noqa: F401  (path setup + TP_EXAMPLES_FORCE_CPU)
+import incubator_mxnet_tpu as mx
+
+
+def lenet_symbol():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=8,
+                            name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="tanh")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2),
+                        stride=(2, 2))
+    c2 = mx.sym.Convolution(p1, kernel=(5, 5), num_filter=16,
+                            name="conv2")
+    a2 = mx.sym.Activation(c2, act_type="tanh")
+    p2 = mx.sym.Pooling(a2, pool_type="max", kernel=(2, 2),
+                        stride=(2, 2))
+    fc1 = mx.sym.FullyConnected(mx.sym.Flatten(p2), num_hidden=64,
+                                name="fc1")
+    a3 = mx.sym.Activation(fc1, act_type="tanh")
+    fc2 = mx.sym.FullyConnected(a3, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def accuracy(mod, data, label):
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(data)]),
+                is_train=False)
+    pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+    return float((pred == label).mean())
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="FGSM adversarial examples (adversary family)")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-examples", type=int, default=512)
+    p.add_argument("--num-epochs", type=int, default=4)
+    p.add_argument("--epsilon", type=float, default=0.15)
+    p.add_argument("--lr", type=float, default=0.1)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+
+    mx.random.seed(0)
+    train = mx.io.MNISTIter(image="absent-train-images",
+                            label="absent-train-labels",
+                            batch_size=args.batch_size, shuffle=True,
+                            num_examples=args.num_examples, seed=0)
+    mod = mx.mod.Module(lenet_symbol(), context=mx.cpu())
+    mod.fit(train, num_epoch=args.num_epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": 0.9},
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              magnitude=2.34),
+            eval_metric="acc")
+    arg_params, aux_params = mod.get_params()
+
+    # adversarial module: same net, inputs_need_grad=True so backward
+    # leaves d(loss)/d(pixels) in get_input_grads()
+    B = args.batch_size
+    adv = mx.mod.Module(lenet_symbol(), context=mx.cpu())
+    adv.bind(data_shapes=[("data", (B, 1, 28, 28))],
+             label_shapes=[("softmax_label", (B,))],
+             for_training=True, inputs_need_grad=True)
+    adv.set_params(arg_params, aux_params)
+
+    train.reset()
+    batch = next(iter(train))
+    x = batch.data[0].asnumpy()
+    lab = batch.label[0].asnumpy().astype(np.int64)
+
+    adv.forward(mx.io.DataBatch(data=[mx.nd.array(x)],
+                                label=[mx.nd.array(lab)]),
+                is_train=True)
+    adv.backward()
+    grad = adv.get_input_grads()[0]
+    perturb = (args.epsilon * mx.nd.sign(grad)).asnumpy()
+    x_adv = np.clip(x + perturb, 0.0, 1.0)
+
+    clean = accuracy(adv, x, lab)
+    fooled = accuracy(adv, x_adv, lab)
+    logging.info("clean-accuracy=%.4f fgsm-accuracy=%.4f (eps=%.3f, "
+                 "mean |perturb|=%.4f)", clean, fooled, args.epsilon,
+                 float(np.abs(perturb).mean()))
+    assert clean > 0.9, "classifier failed to train: %.4f" % clean
+    assert fooled < clean - 0.3, \
+        "FGSM barely moved accuracy: %.4f -> %.4f" % (clean, fooled)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -136,7 +136,20 @@ def _custom_infer_shape(in_shapes, attrs):
     prop = _make_prop(attrs)
     n_args = len(prop.list_arguments())
     if any(s is None for s in in_shapes[:n_args]):
-        return in_shapes, [None] * len(prop.list_outputs()), []
+        # reference semantics (CustomOpProp.InferShape gets whatever is
+        # known and BACK-FILLS the rest — how example/dec's DECLoss
+        # deduces the `mu` shape from `data` alone): attempt the prop's
+        # rule with the partial shapes; a prop that needs more raises,
+        # and shape inference proceeds with everything unknown.
+        try:
+            ins, outs, auxs = prop.infer_shape(
+                [list(s) if s is not None else None
+                 for s in in_shapes[:n_args]])
+        except Exception:
+            return in_shapes, [None] * len(prop.list_outputs()), []
+        return [tuple(s) if s is not None else None for s in ins], \
+            [tuple(s) if s is not None else None for s in outs], \
+            [tuple(s) if s is not None else None for s in auxs]
     ins, outs, auxs = prop.infer_shape([list(s)
                                         for s in in_shapes[:n_args]])
     return [tuple(s) for s in ins], [tuple(s) for s in outs], \

@@ -164,3 +164,58 @@ def test_sequential_with_fused_cell_unroll():
         a[:] = rng.uniform(-0.1, 0.1, a.shape).astype(np.float32)
     ex.forward(is_train=False)
     assert ex.outputs[0].shape == (2, 4, 8)
+
+
+@mxop.register("shapefill")
+class ShapeFillProp(mxop.CustomOpProp):
+    """Prop that BACK-INFERS its parameter's shape from data alone
+    (the reference example/dec DECLoss pattern: ``mu`` has no
+    user-provided shape; InferShape fills it)."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "weight"]
+
+    def list_outputs(self):
+        return ["out"]
+
+    def infer_shape(self, in_shape):
+        n, d = in_shape[0]
+        return [in_shape[0], (3, d)], [(n, 3)], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class ShapeFill(mxop.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0],
+                            np.asarray(in_data[0]).dot(
+                                np.asarray(in_data[1]).T))
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                self.assign(in_grad[0], req[0],
+                            np.zeros_like(np.asarray(in_data[0])))
+                self.assign(in_grad[1], req[1],
+                            np.zeros_like(np.asarray(in_data[1])))
+
+        return ShapeFill()
+
+
+def test_custom_op_back_infers_param_shape():
+    """simple_bind with only the data shape: the prop's infer_shape
+    must fill the parameter's shape (reference CustomOpProp.InferShape
+    back-fill semantics; example/dec relies on it for dec_mu)."""
+    sym = mx.sym.Custom(data=mx.sym.Variable("data"),
+                        weight=mx.sym.Variable("w"),
+                        op_type="shapefill")
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=(5, 4))
+    assert dict(zip(sym.list_arguments(), arg_shapes))["w"] == (3, 4)
+    assert out_shapes[0] == (5, 3)
+    exe = sym.simple_bind(mx.cpu(), grad_req="write", data=(5, 4))
+    assert exe.arg_dict["w"].shape == (3, 4)
+    exe.arg_dict["data"][:] = np.ones((5, 4), np.float32)
+    exe.arg_dict["w"][:] = np.ones((3, 4), np.float32)
+    exe.forward(is_train=False)
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(),
+                               np.full((5, 3), 4.0), rtol=1e-6)

@@ -238,6 +238,28 @@ def test_train_dsd():
     assert len(accs) == 3 and min(accs) > 0.9, accs
 
 
+def test_train_dec():
+    """The DEC family (reference example/dec): autoencoder pretrain ->
+    latent k-means -> KL refinement through a three-input CustomOp whose
+    backward supplies the paper's closed-form z/mu gradients; the driver
+    asserts cluster accuracy AND that the KL objective descends."""
+    out = _run("train_dec.py")
+    assert "done" in out and "kmeans cluster-accuracy" in out
+    import re
+
+    acc = re.search(r"final cluster-accuracy=([0-9.]+)", out)
+    assert acc and float(acc.group(1)) > 0.9, out[-500:]
+
+
+def test_train_adversary_fgsm():
+    """The adversary family (reference example/adversary): FGSM input
+    perturbation via Module's inputs_need_grad binding; clean accuracy
+    must be high and adversarial accuracy collapsed (asserted in the
+    driver)."""
+    out = _run("train_adversary_fgsm.py")
+    assert "done" in out and "fgsm-accuracy" in out
+
+
 def test_train_dcgan():
     out = _run("train_dcgan.py", "--num-epochs", "1",
                "--num-batches", "2", "--size", "32")
