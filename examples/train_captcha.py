@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Multi-digit captcha recognition: one trunk, four softmax heads.
+
+Reference family: ``example/captcha`` (``mxnet_captcha.R``): a captcha
+image holds several digits; a shared conv trunk feeds per-position
+classifier heads trained jointly, and the score that matters is the
+EXACT match — every digit right at once.  This driver exercises the
+multi-output training surface on the TPU-native stack: a
+``mx.sym.Group`` of four ``SoftmaxOutput`` heads, ``Module`` with four
+label names fed from one ``NDArrayIter`` label dict, the ``Accuracy``
+metric zipping over (label, pred) pairs, and an exact-match eval.
+
+Zero-egress: captchas are composed from the same fixed digit templates
+``MNISTIter``'s synthetic fallback uses (four templates side by side
+plus noise), so exact-match accuracy is checkable.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import common  # noqa: F401  (path setup + TP_EXAMPLES_FORCE_CPU)
+import incubator_mxnet_tpu as mx
+
+NUM_DIGITS = 4
+
+
+def captcha_batches(n, seed=0):
+    """(n, 1, 28, 28*4) images of 4 noisy template digits + (n, 4) labels."""
+    templates = np.random.RandomState(42).rand(
+        10, 28, 28).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    rng.rand(8192)  # warm MT19937 (io.py's synthetic-MNIST idiom)
+    labels = rng.randint(0, 10, (n, NUM_DIGITS))
+    img = templates[labels]                       # (n, 4, 28, 28)
+    img = img.transpose(0, 2, 1, 3).reshape(n, 28, 28 * NUM_DIGITS)
+    img = img + rng.randn(*img.shape).astype(np.float32) * 0.3
+    return np.clip(img, 0, 1)[:, None], labels.astype(np.float32)
+
+
+def captcha_symbol():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=16,
+                            name="conv1")
+    p1 = mx.sym.Pooling(mx.sym.Activation(c1, act_type="relu"),
+                        pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = mx.sym.Convolution(p1, kernel=(5, 5), num_filter=32,
+                            name="conv2")
+    p2 = mx.sym.Pooling(mx.sym.Activation(c2, act_type="relu"),
+                        pool_type="max", kernel=(2, 2), stride=(2, 2))
+    trunk = mx.sym.Activation(
+        mx.sym.FullyConnected(mx.sym.Flatten(p2), num_hidden=128,
+                              name="fc_trunk"), act_type="relu")
+    heads = []
+    for i in range(NUM_DIGITS):
+        fc = mx.sym.FullyConnected(trunk, num_hidden=10,
+                                   name="digit%d" % i)
+        heads.append(mx.sym.SoftmaxOutput(
+            fc, label=mx.sym.Variable("digit%d_label" % i),
+            name="softmax%d" % i))
+    return mx.sym.Group(heads)
+
+
+def exact_match(mod, data, labels, batch_size):
+    """Fraction of captchas with ALL digits predicted correctly."""
+    hits, total = 0, 0
+    for s in range(0, len(data) - batch_size + 1, batch_size):
+        mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(data[s:s + batch_size])]), is_train=False)
+        preds = [o.asnumpy().argmax(axis=1)
+                 for o in mod.get_outputs()]
+        want = labels[s:s + batch_size].astype(np.int64)
+        ok = np.ones(batch_size, bool)
+        for i in range(NUM_DIGITS):
+            ok &= preds[i] == want[:, i]
+        hits += int(ok.sum())
+        total += batch_size
+    return hits / float(total)
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="multi-digit captcha (4 softmax heads on one trunk)")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-examples", type=int, default=512)
+    p.add_argument("--num-epochs", type=int, default=12)
+    # NB: all four heads' gradients sum into the shared trunk, so the
+    # workable lr is ~NUM_DIGITS x smaller than the single-head task's
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+
+    if args.num_examples < args.batch_size:
+        p.error("--num-examples must be >= --batch-size")
+    mx.random.seed(0)
+    X, Y = captcha_batches(args.num_examples)
+    label_dict = {"digit%d_label" % i: Y[:, i]
+                  for i in range(NUM_DIGITS)}
+    it = mx.io.NDArrayIter({"data": X}, label_dict,
+                           batch_size=args.batch_size, shuffle=True)
+    mod = mx.mod.Module(captcha_symbol(), data_names=("data",),
+                        label_names=tuple(sorted(label_dict)),
+                        context=mx.cpu())
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": 0.9, "wd": 1e-4},
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              magnitude=2.34),
+            eval_metric="acc")
+
+    acc = exact_match(mod, X, Y, args.batch_size)
+    logging.info("exact-match accuracy=%.4f (all %d digits)",
+                 acc, NUM_DIGITS)
+    assert acc > 0.8, "captcha exact-match too low: %.4f" % acc
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -260,6 +260,19 @@ def test_train_adversary_fgsm():
     assert "done" in out and "fgsm-accuracy" in out
 
 
+def test_train_captcha():
+    """The captcha family (reference example/captcha): one conv trunk,
+    four SoftmaxOutput heads trained jointly through a Group symbol and
+    multi-label Module; exact-match accuracy (all digits right) is
+    asserted in the driver."""
+    out = _run("train_captcha.py")
+    assert "done" in out
+    import re
+
+    acc = re.search(r"exact-match accuracy=([0-9.]+)", out)
+    assert acc and float(acc.group(1)) > 0.8, out[-500:]
+
+
 def test_train_dcgan():
     out = _run("train_dcgan.py", "--num-epochs", "1",
                "--num-batches", "2", "--size", "32")
