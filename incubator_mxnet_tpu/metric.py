@@ -115,7 +115,10 @@ class Accuracy(EvalMetric):
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
             pred, label = _as_np(pred), _as_np(label)
-            if pred.ndim > label.ndim:
+            # reference condition (metric.py:391): ANY shape mismatch
+            # argmaxes — framewise labels (B, T) against (B*T, C)
+            # class scores count flat, not just the ndim>label case
+            if pred.shape != label.shape:
                 pred = pred.argmax(axis=self.axis)
             pred = pred.astype(np.int32).reshape(-1)
             label = label.astype(np.int32).reshape(-1)

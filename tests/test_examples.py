@@ -273,6 +273,15 @@ def test_train_captcha():
     assert acc and float(acc.group(1)) > 0.8, out[-500:]
 
 
+def test_train_speech_frame():
+    """The speech family (reference example/speech-demo, minus Kaldi):
+    continuous filterbank frames through a stacked BiLSTM with a
+    time-distributed softmax; framewise accuracy asserted in the
+    driver."""
+    out = _run("train_speech_frame.py")
+    assert "done" in out and "frame-accuracy" in out
+
+
 def test_train_dcgan():
     out = _run("train_dcgan.py", "--num-epochs", "1",
                "--num-batches", "2", "--size", "32")

@@ -136,6 +136,16 @@ def test_metrics():
     m.update([label], [pred])
     assert abs(m.get()[1] - 2.0 / 3) < 1e-6
 
+    # framewise labels: (B, T) labels vs (B*T, C) class scores — the
+    # reference argmaxes on ANY shape mismatch (metric.py:391) and
+    # counts flat (time-distributed softmax, speech/bi-lstm drivers)
+    frame_pred = mx.nd.array([[0.9, 0.1], [0.2, 0.8],
+                              [0.6, 0.4], [0.3, 0.7]])
+    frame_label = mx.nd.array([[0, 1], [1, 1]])       # (B=2, T=2)
+    fm = mx.metric.Accuracy()
+    fm.update([frame_label], [frame_pred])
+    assert abs(fm.get()[1] - 3.0 / 4) < 1e-6
+
     ce = mx.metric.create("ce")
     ce.update([label], [pred])
     expect = -(np.log(0.9) + np.log(0.8) + np.log(0.3)) / 3
