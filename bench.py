@@ -133,6 +133,13 @@ def _resnet_record(small):
 
 def main():
     small = os.environ.get("TP_BENCH_SMALL") == "1"
+    # telemetry snapshot rides along with the BENCH record (JSONL next to
+    # stdout JSON); TP_BENCH_TELEMETRY=0 opts out
+    tele_path = os.environ.get("TP_BENCH_TELEMETRY", "BENCH_telemetry.jsonl")
+    if tele_path != "0":
+        from incubator_mxnet_tpu import telemetry
+
+        telemetry.enable(tele_path)
     resnet = _resnet_record(small)
     print(json.dumps(resnet))
 
@@ -170,6 +177,10 @@ def main():
     combined["vs_baseline"] = resnet.get("vs_baseline")
     combined["vs_baseline_metric"] = resnet["metric"]
     combined["resnet50"] = resnet
+    if tele_path != "0":
+        from incubator_mxnet_tpu import telemetry
+
+        telemetry.flush()
     print(json.dumps(combined))
 
 

@@ -74,7 +74,8 @@ _OPTIONAL = [
     ("optimizer", ()), ("lr_scheduler", ()), ("metric", ()), ("io", ()),
     ("recordio", ()), ("kvstore", ("kv",)), ("callback", ()),
     ("monitor", ()), ("module", ("mod",)), ("name", ()), ("attribute", ()),
-    ("registry", ()), ("profiler", ()), ("visualization", ("viz",)),
+    ("registry", ()), ("profiler", ()), ("telemetry", ()),
+    ("visualization", ("viz",)),
     ("test_utils", ()), ("parallel", ()), ("models", ()), ("gluon", ()),
     ("rnn", ()), ("image", ()), ("operator", ()), ("rtc", ()),
     ("contrib", ()), ("log", ()), ("libinfo", ()), ("torch", ()),

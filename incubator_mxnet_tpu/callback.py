@@ -6,6 +6,8 @@ import logging
 import math
 import time
 
+from . import telemetry
+
 __all__ = ["Speedometer", "do_checkpoint", "module_checkpoint",
            "ProgressBar", "LogValidationMetricsCallback"]
 
@@ -30,8 +32,12 @@ class Speedometer:
         self.last_count = count
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / \
-                    (time.time() - self.tic)
+                # monotonic: wall-clock steps (NTP, DST) must not yield
+                # negative elapsed; clamp avoids ZeroDivisionError when two
+                # callbacks land within timer resolution
+                elapsed = time.monotonic() - self.tic
+                speed = self.frequent * self.batch_size / max(elapsed, 1e-9)
+                telemetry.gauge("speedometer_samples_per_sec").set(speed)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
@@ -44,10 +50,10 @@ class Speedometer:
                     logging.info(
                         "Iter[%d] Batch [%d]	Speed: %.2f samples/sec",
                         param.epoch, count, speed)
-                self.tic = time.time()
+                self.tic = time.monotonic()
         else:
             self.init = True
-            self.tic = time.time()
+            self.tic = time.monotonic()
 
 
 def do_checkpoint(prefix: str, period: int = 1):

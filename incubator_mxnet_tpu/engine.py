@@ -19,6 +19,7 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, List, Optional
 
+from . import telemetry
 from .base import get_env
 
 __all__ = ["Engine", "engine", "naive_mode", "waitall"]
@@ -50,31 +51,43 @@ class Engine:
     def push(self, fn: Callable[[], Any], name: str = "op") -> Any:
         """Run an op.  JAX already dispatches asynchronously; in naive mode we
         additionally fence so errors surface at the faulting op."""
+        telemetry.counter("engine_dispatch_total").inc()
         if self._profile_hooks:
             t0 = time.perf_counter()
             out = fn()
             if self.naive:
+                telemetry.counter("engine_naive_fence_total").inc()
                 out = _block(out)
             t1 = time.perf_counter()
             for hook in self._profile_hooks:
                 hook(name, t0, t1)
             self._inflight.append(out)
+            telemetry.gauge("engine_inflight_depth").set(
+                len(self._inflight))
             return out
         out = fn()
         if self.naive:
+            telemetry.counter("engine_naive_fence_total").inc()
             out = _block(out)
         else:
             self._inflight.append(out)
+            if telemetry.enabled():
+                telemetry.gauge("engine_inflight_depth").set(
+                    len(self._inflight))
         return out
 
     def wait_for_var(self, data) -> None:
+        telemetry.counter("engine_wait_for_var_total").inc()
         _block(data)
 
     def wait_for_all(self) -> None:
         """Block on recently dispatched work, surfacing any async error here
         (``Engine::WaitForAll`` contract)."""
+        telemetry.counter("engine_waitall_total").inc()
         while self._inflight:
             _block(self._inflight.popleft())
+        if telemetry.enabled():
+            telemetry.gauge("engine_inflight_depth").set(0)
 
     # -- profiler hook (engine-level per-op stats) -------------------------
     def add_profile_hook(self, hook) -> None:

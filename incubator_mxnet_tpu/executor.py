@@ -19,17 +19,37 @@ ops, binding lowers the symbol DAG to a jax function and compiles it with
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from . import random as _random
+from . import telemetry
 from .base import MXNetError, dtype_np
 from .context import Context, current_context
 from .ndarray.ndarray import NDArray
 from .ops.registry import OpContext
 
 __all__ = ["Executor"]
+
+
+def _time_first_call(fn, metric: str):
+    """Observe the first invocation's wall time (trace + XLA compile) into
+    ``metric``; later calls go straight through.  Only installed when
+    telemetry is enabled, so the disabled hot path keeps the bare jit fn."""
+    state = {"fn": None}
+
+    def wrapper(*a, **kw):
+        if state["fn"] is None:
+            t0 = time.perf_counter()
+            out = fn(*a, **kw)
+            telemetry.histogram(metric).observe(time.perf_counter() - t0)
+            state["fn"] = fn
+            return out
+        return state["fn"](*a, **kw)
+
+    return wrapper
 
 
 class Executor:
@@ -101,17 +121,25 @@ class Executor:
         if is_train not in self._fwd_jit:
             import jax
 
+            telemetry.counter("jit_compile_total").inc()
+            t0 = time.perf_counter()
             fn = self._lowered(is_train)
             # grouped driver already jits per segment; the driver itself
             # must stay eager (cross-device transfers inside)
-            self._fwd_jit[is_train] = fn if self._is_grouped() \
-                else jax.jit(fn)
+            jitted = fn if self._is_grouped() else jax.jit(fn)
+            telemetry.histogram("jit_build_seconds").observe(
+                time.perf_counter() - t0)
+            if telemetry.enabled() and not self._is_grouped():
+                jitted = _time_first_call(jitted, "jit_compile_seconds")
+            self._fwd_jit[is_train] = jitted
         return self._fwd_jit[is_train]
 
     def _get_bwd(self):
         if self._bwd_jit is None:
             import jax
 
+            telemetry.counter("jit_compile_total").inc()
+            t0 = time.perf_counter()
             core = self._lowered(True)
             diff_names = [n for n in self._arg_names
                           if self._grad_req.get(n, "null") != "null"]
@@ -136,7 +164,12 @@ class Executor:
                 (grads,) = vjp_fn((ct_outs, ct_aux))
                 return outs, new_aux, grads
 
-            self._bwd_jit = bwd if self._is_grouped() else jax.jit(bwd)
+            jitted = bwd if self._is_grouped() else jax.jit(bwd)
+            telemetry.histogram("jit_build_seconds").observe(
+                time.perf_counter() - t0)
+            if telemetry.enabled() and not self._is_grouped():
+                jitted = _time_first_call(jitted, "jit_compile_seconds")
+            self._bwd_jit = jitted
         return self._bwd_jit
 
     # ----------------------------------------------------------------- run

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from . import telemetry
 from .base import MXNetError, get_env
 from .ndarray.ndarray import NDArray
 from .ndarray import zeros as nd_zeros
@@ -69,9 +70,14 @@ class KVStore:
         """Aggregate (sum) pushed values per key; run updater if set
         (``KVStoreLocal::Push``, kvstore_local.h:83)."""
         keys, values = _key_value(key, value)
+        _tele = telemetry.enabled()
         for k, vlist in zip(keys, values):
             if not isinstance(vlist, list):
                 vlist = [vlist]
+            if _tele:
+                telemetry.counter("kvstore_push_total").inc()
+                telemetry.counter("kvstore_push_bytes_total").inc(
+                    sum(_nd_bytes(v) for v in vlist))
             merged = self._reduce(vlist)
             if self._updater is not None:
                 self._updater(_updater_key(k), merged, self._store[k])
@@ -80,10 +86,15 @@ class KVStore:
 
     def pull(self, key, out=None, priority: int = 0) -> None:
         keys, outs = _key_value(key, out)
+        _tele = telemetry.enabled()
         for k, olist in zip(keys, outs):
             if not isinstance(olist, list):
                 olist = [olist]
             src = self._store[k]
+            if _tele:
+                telemetry.counter("kvstore_pull_total").inc()
+                telemetry.counter("kvstore_pull_bytes_total").inc(
+                    _nd_bytes(src) * len(olist))
             for o in olist:
                 # broadcast to each destination's device
                 o._set_data(_place_like(src, o))
@@ -168,6 +179,7 @@ class KVStore:
     def barrier(self) -> None:
         from .engine import waitall
 
+        telemetry.counter("kvstore_barrier_total").inc()
         waitall()
 
     def _barrier_before_exit(self):
@@ -275,6 +287,10 @@ class DistKVStore(KVStore):
         import jax
 
         data = arr.data
+        if telemetry.enabled():
+            telemetry.counter("kvstore_allreduce_total").inc()
+            telemetry.counter("kvstore_allreduce_bytes_total").inc(
+                _nd_bytes(arr))
         sig = (tuple(data.shape), str(data.dtype))
         fn = self._psum_allreduce_cache.get(sig)
         if fn is None:
@@ -307,9 +323,14 @@ class DistKVStore(KVStore):
 
     def push(self, key, value, priority: int = 0) -> None:
         keys, values = _key_value(key, value)
+        _tele = telemetry.enabled()
         for k, vlist in zip(keys, values):
             if not isinstance(vlist, list):
                 vlist = [vlist]
+            if _tele:
+                telemetry.counter("kvstore_push_total").inc()
+                telemetry.counter("kvstore_push_bytes_total").inc(
+                    sum(_nd_bytes(v) for v in vlist))
             merged = self._reduce(vlist)          # intra-process devices
             if self._ps_client is not None:
                 # async: the server applies immediately; nothing local
@@ -325,10 +346,15 @@ class DistKVStore(KVStore):
         if self._ps_client is None:
             return super().pull(key, out=out, priority=priority)
         keys, outs = _key_value(key, out)
+        _tele = telemetry.enabled()
         for k, olist in zip(keys, outs):
             if not isinstance(olist, list):
                 olist = [olist]
             val = self._ps_client.pull(k, self._store[k].asnumpy())
+            if _tele:
+                telemetry.counter("kvstore_pull_total").inc()
+                telemetry.counter("kvstore_pull_bytes_total").inc(
+                    val.nbytes * len(olist))
             for o in olist:
                 o._set_data(_place_like(NDArray(val), o))
 
@@ -354,6 +380,7 @@ class DistKVStore(KVStore):
         if self._ps_client is not None:
             from .engine import waitall
 
+            telemetry.counter("kvstore_barrier_total").inc()
             waitall()
             self._ps_client.barrier()
             return
@@ -452,6 +479,18 @@ def _build_process_psum(shape, dtype):
         return out.addressable_shards[0].data
 
     return fn
+
+
+def _nd_bytes(arr) -> int:
+    """Payload size of an NDArray-ish value (shape × itemsize; safe on
+    anything exposing .shape and .dtype)."""
+    try:
+        size = 1
+        for s in arr.shape:
+            size *= int(s)
+        return size * np.dtype(arr.dtype).itemsize
+    except (TypeError, ValueError, AttributeError):
+        return 0
 
 
 def _key_value(key, value):

@@ -6,10 +6,20 @@ write-back / RNG-key folding semantics), including the recompute
 ``jax.checkpoint``."""
 from __future__ import annotations
 
+import time
+import weakref
+
+from . import telemetry
 from .base import get_env
 from .ops.registry import OpContext
 
 __all__ = ["lower_symbol", "lower_symbol_grouped", "resolve_remat"]
+
+# Symbol → {(is_train, remat): lowered fn}.  The lowered function is a pure
+# function of the node DAG, so executors bound over the same Symbol share
+# one fn — and because jax.jit caches by function identity, they share one
+# XLA compilation too.  WeakKey so dropping the Symbol drops the entry.
+_LOWER_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 # Ops whose outputs stay resident under the mirror policy: the matmul /
@@ -49,8 +59,37 @@ def resolve_remat(remat):
 
 
 def lower_symbol(symbol, is_train: bool, remat=None):
+    """Cached entry over :func:`_lower_symbol_impl`: the per-(symbol,
+    mode, remat) lowering is memoized so repeated binds of one Symbol
+    (bucketing, shared modules, fwd+bwd over the same graph) skip the
+    topo interpretation AND reuse jax.jit's by-identity compile cache.
+    Telemetry: ``lowering_cache_{hits,misses}_total``,
+    ``lowering_seconds``."""
+    remat = resolve_remat(remat) if is_train else None
+    ck = (bool(is_train), remat)
+    try:
+        bucket = _LOWER_CACHE.get(symbol)
+    except TypeError:           # unhashable symbol: skip caching
+        bucket = None
+    if bucket is not None and ck in bucket:
+        telemetry.counter("lowering_cache_hits_total").inc()
+        return bucket[ck]
+    telemetry.counter("lowering_cache_misses_total").inc()
+    t0 = time.perf_counter()
+    fn = _lower_symbol_impl(symbol, is_train, remat)
+    telemetry.histogram("lowering_seconds").observe(
+        time.perf_counter() - t0)
+    try:
+        _LOWER_CACHE.setdefault(symbol, {})[ck] = fn
+    except TypeError:
+        pass
+    return fn
+
+
+def _lower_symbol_impl(symbol, is_train: bool, remat):
     """Lower a Symbol DAG to ``fn(arg_vals, aux_vals, key) ->
-    (outputs, new_aux)``.
+    (outputs, new_aux)``.  ``remat`` arrives pre-resolved (``None``,
+    ``'mirror'``, or an int K).
 
     The returned function is pure and jax-traceable: topological
     interpretation of the node DAG over the op registry, with per-node
@@ -72,7 +111,6 @@ def lower_symbol(symbol, is_train: bool, remat=None):
     nodes = symbol.topo_nodes()
     outputs = symbol._outputs
     aux_names = set(symbol.list_auxiliary_states())
-    remat = resolve_remat(remat) if is_train else None
 
     mirror = remat == "mirror"
 
@@ -214,6 +252,8 @@ def lower_symbol_grouped(symbol, is_train: bool, group2ctx, default_device):
     invoked eagerly (do not wrap in jax.jit).
     """
     import jax
+
+    telemetry.counter("lowering_grouped_total").inc()
 
     nodes = symbol.topo_nodes()
     outputs = symbol._outputs

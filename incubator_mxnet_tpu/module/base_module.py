@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import metric as metric_mod
+from .. import telemetry
 from ..base import MXNetError
 from ..initializer import Uniform
 from ..model import BatchEndParam
@@ -148,6 +149,19 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        # sampled once per fit: telemetry can't toggle mid-training, and the
+        # disabled loop must not pay even the enabled() call per step
+        _tele = telemetry.enabled()
+        if _tele:
+            from ..base import get_env
+
+            _step_fence = get_env("TELEMETRY_STEP_FENCE", False, bool)
+            _step_hist = telemetry.histogram("step_latency_seconds")
+            _steps_ctr = telemetry.counter("steps_total")
+            _samples_ctr = telemetry.counter("samples_total")
+            _sps_gauge = telemetry.gauge("samples_per_sec")
+            _epochs_ctr = telemetry.counter("epochs_total")
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -166,8 +180,31 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
+                if _tele:
+                    _t0 = time.monotonic()
                 self.forward_backward(data_batch)
                 self.update()
+                if _tele:
+                    if _step_fence:
+                        # true readback fence: host-read one scalar so the
+                        # latency sample covers device execution, not just
+                        # async dispatch (block_until_ready is unreliable
+                        # on some platforms — see PERF.md)
+                        try:
+                            outs = self.get_outputs()
+                            if outs:
+                                np.asarray(outs[0].data).ravel()[:1]
+                        except Exception:
+                            pass
+                    _dt = time.monotonic() - _t0
+                    _step_hist.observe(_dt)
+                    _steps_ctr.inc()
+                    _shape = getattr(data_batch.data[0], "shape",
+                                     ()) if data_batch.data else ()
+                    _bs = _shape[0] if _shape else 0
+                    if _bs:
+                        _samples_ctr.inc(_bs)
+                        _sps_gauge.set(_bs / max(_dt, 1e-9))
                 try:
                     next_data_batch = next(data_iter)
                     self.prepare(next_data_batch)
@@ -188,6 +225,9 @@ class BaseModule:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
                              time.time() - tic)
+            if _tele:
+                _epochs_ctr.inc()
+                telemetry.flush()
 
             arg_p, aux_p = self.get_params()
             self.set_params(arg_p, aux_p)

@@ -7,6 +7,7 @@ import logging
 import re
 from typing import Callable, List, Optional
 
+from . import telemetry
 from .ndarray.ndarray import NDArray
 
 __all__ = ["Monitor"]
@@ -53,6 +54,13 @@ class Monitor:
         self.queue = []
         if self.sort:
             res = sorted(res, key=lambda x: x[1])
+        if telemetry.enabled():
+            for _, name, value in res:
+                try:
+                    telemetry.gauge("monitor_stat",
+                                    {"tensor": name}).set(float(value))
+                except (TypeError, ValueError):
+                    pass  # stat_func may return non-scalar stats
         return res
 
     def toc_print(self) -> None:

@@ -9,26 +9,58 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+from .. import telemetry
+
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "ring_permute",
            "barrier_sync"]
+
+_KIND_LABELS = {}
+
+
+def _count(kind: str, x) -> None:
+    """Record one collective invocation + its per-shard payload bytes.
+
+    These wrappers run inside jit/shard_map *tracing*, so counts are
+    trace-time (once per compiled program), not per-execution — still the
+    right signal for "what collectives does this model build, and how big".
+    """
+    if not telemetry.enabled():
+        return
+    lab = _KIND_LABELS.get(kind)
+    if lab is None:
+        lab = _KIND_LABELS[kind] = {"kind": kind}
+    telemetry.counter("collective_calls_total", lab).inc()
+    try:
+        import numpy as np
+
+        size = 1
+        for s in x.shape:
+            size *= int(s)
+        telemetry.counter("collective_bytes_total", lab).inc(
+            size * np.dtype(x.dtype).itemsize)
+    except (TypeError, ValueError, AttributeError):
+        pass
 
 
 def all_reduce(x, axis_name: str = "dp"):
     """Sum across a mesh axis (inside shard_map/pjit tracing)."""
     import jax
 
+    _count("all_reduce", x)
     return jax.lax.psum(x, axis_name)
 
 
 def all_gather(x, axis_name: str = "dp", axis: int = 0, tiled: bool = True):
     import jax
 
+    _count("all_gather", x)
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis_name: str = "dp", scatter_dimension: int = 0):
     import jax
 
+    _count("reduce_scatter", x)
     return jax.lax.psum_scatter(x, axis_name,
                                 scatter_dimension=scatter_dimension,
                                 tiled=True)
@@ -39,6 +71,7 @@ def ring_permute(x, axis_name: str, shift: int = 1):
     pipeline building block)."""
     import jax
 
+    _count("ring_permute", x)
     n = jax.lax.axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name, perm)
@@ -48,6 +81,9 @@ def barrier_sync(name: str = "barrier"):
     """Host-level barrier across processes (ps-lite Barrier analog)."""
     import jax
 
+    if telemetry.enabled():
+        telemetry.counter("collective_calls_total",
+                          {"kind": "barrier_sync"}).inc()
     if jax.process_count() > 1:
         from jax.experimental.multihost_utils import sync_global_devices
 

@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import telemetry
 from ..base import MXNetError, dtype_np, get_env
 from ..ops.registry import OpContext, get_op
 from .mesh import (data_parallel_spec, default_mesh, replicated_spec)
@@ -403,6 +404,7 @@ class FusedTrainStep:
         import jax
         import jax.numpy as jnp
 
+        telemetry.counter("jit_compile_total").inc()
         fwd = _lower_symbol(self.symbol, is_train=True, remat=self.remat)
         opt_op = get_op(self._opt_op)
         opt_attrs = dict(self._opt_attrs)
@@ -544,6 +546,7 @@ class FusedTrainStep:
         import jax
         import jax.numpy as jnp
 
+        telemetry.counter("fused_steps_total").inc()
         self.num_update += 1
         lr = self.lr_scheduler(self.num_update) if self.lr_scheduler \
             else self.lr

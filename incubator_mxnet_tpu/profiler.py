@@ -34,7 +34,8 @@ from typing import List, Optional
 from .base import get_env
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "pause", "resume", "Scope", "start_xla_trace", "stop_xla_trace"]
+           "pause", "resume", "Scope", "record_counter",
+           "start_xla_trace", "stop_xla_trace"]
 
 _lock = threading.Lock()
 
@@ -58,6 +59,10 @@ class _Profiler:
         self.filename = get_env("PROFILER_FILENAME", "profile.json")
         self.running = False
         self.events: List[_Event] = []
+        # (name, value, t) triples from the telemetry registry — NOT gated
+        # on ``running``: the metrics layer decides when to publish, the
+        # trace is just one of its exposition formats
+        self.counters: List[tuple] = []
         self._hook_installed = False
         self._epoch = time.perf_counter()
 
@@ -88,12 +93,21 @@ class _Profiler:
             return
         self.record(name, t0, t1)
 
+    def record_counter(self, name: str, value: float,
+                       t: Optional[float] = None) -> None:
+        """Append a Chrome counter sample (``"ph": "C"``) — the shared-
+        timeline exposition for telemetry counters/gauges."""
+        t = time.perf_counter() if t is None else t
+        with _lock:
+            self.counters.append((name, float(value), t))
+
     def dump(self, fname: Optional[str] = None) -> str:
         """Write accumulated events as Chrome trace-event JSON
         (``Profiler::DumpProfile`` / ``EmitEvent``, profiler.h:75-148)."""
         fname = fname or self.filename
         with _lock:
             events = list(self.events)
+            counters = list(self.counters)
         traces = []
         # process-name metadata, like EmitPid
         tids = sorted({e.tid for e in events})
@@ -110,6 +124,12 @@ class _Profiler:
             traces.append({
                 "name": e.name, "cat": e.cat, "ph": "E",
                 "ts": self.now_us(e.t1), "pid": 0, "tid": e.tid,
+            })
+        for name, value, t in counters:
+            traces.append({
+                "name": name, "cat": "telemetry", "ph": "C",
+                "ts": self.now_us(t), "pid": 0, "tid": 0,
+                "args": {"value": value},
             })
         with open(fname, "w") as f:
             json.dump({"traceEvents": traces, "displayTimeUnit": "ms"}, f)
@@ -131,6 +151,7 @@ def profiler_set_state(state: str = "stop") -> None:
     if state in ("run", 1):
         with _lock:
             _prof.events = []  # fresh capture per run/stop session
+            _prof.counters = []
         _prof.install_hook()
         _prof.running = True
     elif state in ("stop", 0):
@@ -151,6 +172,12 @@ def resume() -> None:
 
 def dump_profile(fname: Optional[str] = None) -> str:
     return _prof.dump(fname)
+
+
+def record_counter(name: str, value: float,
+                   t: Optional[float] = None) -> None:
+    """Telemetry-facing entry: add one counter sample to the trace."""
+    _prof.record_counter(name, value, t)
 
 
 class Scope:

@@ -33,9 +33,21 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import telemetry
 from .base import MXNetError, get_env
 
 __all__ = ["Scheduler", "PSServer", "PSClient", "node_env", "DEFAULT_PORT"]
+
+# per-verb label dicts are interned so the enabled data path never
+# allocates a fresh dict per RPC
+_VERB_LABELS: Dict[str, Dict[str, str]] = {}
+
+
+def _verb_labels(verb: str) -> Dict[str, str]:
+    lab = _VERB_LABELS.get(verb)
+    if lab is None:
+        lab = _VERB_LABELS[verb] = {"verb": verb}
+    return lab
 
 DEFAULT_PORT = 9091
 _HDR = struct.Struct("!I")
@@ -428,7 +440,8 @@ class PSServer(_Node):
                 _rpc(self.scheduler, {"cmd": "heartbeat", "node": node},
                      timeout=10.0)
             except OSError:
-                pass
+                telemetry.counter("ps_heartbeat_miss_total",
+                                  {"role": "server"}).inc()
 
     def _apply(self, key, grad):
         if self._updater is not None:
@@ -570,13 +583,19 @@ class PSClient:
                 _rpc(self.scheduler, {"cmd": "heartbeat",
                                       "node": self.node})
             except OSError:
-                pass
+                telemetry.counter("ps_heartbeat_miss_total",
+                                  {"role": "worker"}).inc()
 
     def dead_nodes(self, timeout: float = 60) -> List[str]:
         reply = _rpc(self.scheduler, {"cmd": "dead_nodes",
                                       "timeout": timeout,
                                       "node": self.node})
-        return reply.get("dead", [])
+        dead = reply.get("dead", [])
+        if telemetry.enabled():
+            telemetry.gauge("ps_dead_nodes").set(len(dead))
+            if dead:
+                telemetry.counter("ps_dead_node_events_total").inc()
+        return dead
 
     # ------------------------------------------------------------- placement
     def _plan(self, key, arr: np.ndarray):
@@ -608,14 +627,32 @@ class PSClient:
         replacement registration, re-seed it, retry once.
         """
         last_exc: Optional[BaseException] = None
+        tele = telemetry.enabled()
+        if tele:
+            lab = _verb_labels(msg.get("cmd", "?"))
+            telemetry.counter("ps_rpc_total", lab).inc()
+            v = msg.get("value")
+            if isinstance(v, np.ndarray):
+                telemetry.counter("ps_rpc_bytes_total", lab).inc(v.nbytes)
+            t0 = time.monotonic()
         # up to 3 recovery rounds: one generation bump can satisfy the
         # wait while OUR server's replacement is still registering (a
         # different server died too), so the retry may trip again
         for attempt in range(3):
             try:
-                return self._pool.rpc(self.servers[sidx], msg)
+                reply = self._pool.rpc(self.servers[sidx], msg)
+                if tele:
+                    telemetry.histogram("ps_rpc_seconds", lab).observe(
+                        time.monotonic() - t0)
+                    rv = reply.get("value") if isinstance(reply, dict) \
+                        else None
+                    if isinstance(rv, np.ndarray):
+                        telemetry.counter("ps_rpc_bytes_total",
+                                          lab).inc(rv.nbytes)
+                return reply
             except (ConnectionError, OSError) as exc:
                 last_exc = exc
+                telemetry.counter("ps_rpc_retries_total").inc()
                 if not self.recover_servers:
                     break
                 self._recover(sidx)
@@ -641,6 +678,7 @@ class PSClient:
         sync-mode merge that lost a member cannot be reconstructed, so
         sync jobs fail cleanly instead (kvstore.py gates the flag).
         """
+        telemetry.counter("ps_server_recovery_total").inc()
         reply = _rpc(self.scheduler,
                      {"cmd": "get_nodes", "node": self.node,
                       "min_gen": self._gen + 1}, timeout=300.0)

@@ -1,0 +1,493 @@
+"""Unified runtime telemetry — process-wide metrics registry + exposition.
+
+The reference engine captured per-op ``OprExecStat`` inside the profiler
+(``src/engine/profiler.{h,cc}``); everything quantitative beyond spans
+(throughput, queue depths, RPC counts) lived in ad-hoc log lines.  This
+module is the shared metrics substrate those signals publish through:
+
+- **Counters** (monotonic), **gauges** (last value) and **histograms**
+  (count/sum/min/max + a bounded reservoir for quantiles), all
+  thread-safe and key-addressed by ``name`` + optional label dict.
+- **Env-gated**: metrics exist only when ``TP_TELEMETRY=1`` (or a test
+  calls :func:`enable`).  When off, every accessor returns one shared
+  no-op singleton — instrumentation sites cost a function call and
+  allocate nothing, so the hot path is unchanged.
+- **Exposition**: :func:`flush` appends one JSON snapshot per line to a
+  JSONL sink (diffable against ``BENCH_*.json``), :func:`prometheus_text`
+  renders the Prometheus text format, and :func:`serve` scrapes it over
+  HTTP.  Each flush also emits every counter/gauge into the Chrome trace
+  as ``"ph": "C"`` counter events (``profiler.py``), so one
+  ``profile.json`` shows spans and metrics on a shared timeline.
+
+Instrumented layers: ``lowering`` (compile counts/wall-time, lowering
+cache), ``executor``/``module`` (step latency, samples/sec, epochs),
+``engine`` (dispatch counts, fences, in-flight depth), ``ps``/``kvstore``
+(RPC count/bytes/latency per verb, retries, heartbeats, dead nodes),
+``parallel.collectives`` (invocations by kind + payload bytes), and
+device memory via ``jax.local_devices()[*].memory_stats()``.
+
+Env controls::
+
+    TP_TELEMETRY=1            enable the registry
+    TP_TELEMETRY_PATH=...     JSONL sink (default telemetry.jsonl)
+    TP_TELEMETRY_TRACE=0      suppress the exit-time counter-event trace dump
+    TP_TELEMETRY_STEP_FENCE=1 per-step true readback fence in Module.fit
+    TP_TELEMETRY_RESERVOIR=N  histogram reservoir size (default 1024)
+    TP_TELEMETRY_PORT=N       serve Prometheus text on http://:N/metrics
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import random
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from .base import get_env
+
+__all__ = [
+    "enabled", "enable", "disable", "counter", "gauge", "histogram",
+    "snapshot", "flush", "prometheus_text", "serve", "registry",
+    "Counter", "Gauge", "Histogram", "Registry",
+]
+
+
+# ---------------------------------------------------------------------------
+# no-op singletons (the disabled-mode hot path)
+# ---------------------------------------------------------------------------
+
+
+class _NullTimer:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_TIMER = _NullTimer()
+
+
+class _NullMetric:
+    """Shared no-op standing in for every metric when telemetry is off.
+
+    All mutators are allocation-free so per-step instrumentation adds no
+    garbage to the hot path (asserted by ``tests/test_telemetry.py``).
+    """
+
+    __slots__ = ()
+
+    def inc(self, n=1):
+        pass
+
+    def dec(self, n=1):
+        pass
+
+    def set(self, v):
+        pass
+
+    def observe(self, v):
+        pass
+
+    def time(self):
+        return _NULL_TIMER
+
+
+_NULL = _NullMetric()
+
+
+# ---------------------------------------------------------------------------
+# metric types
+# ---------------------------------------------------------------------------
+
+
+def _format_key(name: str, labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return name
+    return "%s{%s}" % (name, ",".join('%s="%s"' % (k, v)
+                                      for k, v in labels))
+
+
+class _Timer:
+    __slots__ = ("_hist", "_t0")
+
+    def __init__(self, hist):
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.perf_counter() - self._t0)
+        return False
+
+
+class _Metric:
+    __slots__ = ("name", "labels", "_lock")
+    kind = "metric"
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...]):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+
+    @property
+    def key(self) -> str:
+        return _format_key(self.name, self.labels)
+
+    def time(self):
+        return _NULL_TIMER
+
+
+class Counter(_Metric):
+    """Monotonic counter (``_total`` convention)."""
+
+    __slots__ = ("_value",)
+    kind = "counter"
+
+    def __init__(self, name, labels):
+        super().__init__(name, labels)
+        self._value = 0
+
+    def inc(self, n=1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def snap(self) -> Dict[str, Any]:
+        return {"type": "counter", "value": self._value}
+
+
+class Gauge(_Metric):
+    """Last-value gauge."""
+
+    __slots__ = ("_value",)
+    kind = "gauge"
+
+    def __init__(self, name, labels):
+        super().__init__(name, labels)
+        self._value = 0.0
+
+    def set(self, v) -> None:
+        self._value = float(v)
+
+    def inc(self, n=1) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self):
+        return self._value
+
+    def snap(self) -> Dict[str, Any]:
+        return {"type": "gauge", "value": self._value}
+
+
+class Histogram(_Metric):
+    """count/sum/min/max plus a bounded reservoir for quantiles.
+
+    The reservoir holds at most ``TP_TELEMETRY_RESERVOIR`` samples
+    (default 1024); beyond that, uniform reservoir sampling keeps memory
+    bounded for arbitrarily long runs while quantile estimates stay
+    representative of the whole stream.
+    """
+
+    __slots__ = ("count", "sum", "min", "max", "_reservoir", "_cap")
+    kind = "histogram"
+
+    def __init__(self, name, labels):
+        super().__init__(name, labels)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self._cap = int(get_env("TELEMETRY_RESERVOIR", 1024, int))
+        self._reservoir = []
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+            if len(self._reservoir) < self._cap:
+                self._reservoir.append(v)
+            else:
+                i = random.randrange(self.count)
+                if i < self._cap:
+                    self._reservoir[i] = v
+
+    def time(self):
+        return _Timer(self)
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            res = sorted(self._reservoir)
+        if not res:
+            return None
+        idx = min(len(res) - 1, int(q * len(res)))
+        return res[idx]
+
+    def snap(self) -> Dict[str, Any]:
+        with self._lock:
+            res = sorted(self._reservoir)
+            out = {"type": "histogram", "count": self.count,
+                   "sum": self.sum, "min": self.min, "max": self.max}
+        for q in (0.5, 0.9, 0.99):
+            if res:
+                out["p%d" % int(q * 100)] = \
+                    res[min(len(res) - 1, int(q * len(res)))]
+        return out
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+class Registry:
+    """Process-wide metric store; one instance lives while enabled."""
+
+    def __init__(self, jsonl_path: Optional[str] = None):
+        self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                            _Metric] = {}
+        self._lock = threading.Lock()
+        self.jsonl_path = jsonl_path or get_env("TELEMETRY_PATH",
+                                                "telemetry.jsonl")
+
+    def get(self, cls, name: str,
+            labels: Optional[Dict[str, str]] = None) -> _Metric:
+        lab = tuple(sorted((str(k), str(v))
+                           for k, v in labels.items())) if labels else ()
+        key = (name, lab)
+        m = self._metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    m = cls(name, lab)
+                    self._metrics[key] = m
+        return m
+
+    def metrics(self):
+        with self._lock:
+            return list(self._metrics.values())
+
+    # ------------------------------------------------------------ exposition
+    def snapshot(self) -> Dict[str, Any]:
+        """One point-in-time dict: ``{"ts": ..., "metrics": {key: snap}}``."""
+        self.record_device_memory()
+        return {"ts": time.time(),
+                "metrics": {m.key: m.snap() for m in self.metrics()}}
+
+    def flush(self, path: Optional[str] = None) -> str:
+        """Append one snapshot line to the JSONL sink and mirror every
+        counter/gauge into the Chrome trace as a ``"ph": "C"`` event."""
+        snap = self.snapshot()
+        path = path or self.jsonl_path
+        with open(path, "a") as f:
+            f.write(json.dumps(snap) + "\n")
+        self._emit_trace_counters(snap)
+        return path
+
+    def _emit_trace_counters(self, snap: Dict[str, Any]) -> None:
+        from . import profiler
+
+        for key, s in snap["metrics"].items():
+            if s["type"] in ("counter", "gauge"):
+                profiler.record_counter(key, s["value"])
+            else:  # histogram: count is the useful time series
+                profiler.record_counter(key + ".count", s["count"])
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition text format (counters/gauges as-is,
+        histograms as summaries with reservoir quantiles)."""
+        by_name: Dict[str, list] = {}
+        for m in self.metrics():
+            by_name.setdefault(m.name, []).append(m)
+        lines = []
+        for name in sorted(by_name):
+            group = by_name[name]
+            kind = group[0].kind
+            lines.append("# TYPE %s %s" % (
+                name, "summary" if kind == "histogram" else kind))
+            for m in group:
+                if kind == "histogram":
+                    for q in (0.5, 0.9, 0.99):
+                        v = m.quantile(q)
+                        if v is None:
+                            continue
+                        lab = m.labels + (("quantile", str(q)),)
+                        lines.append("%s %g" % (_format_key(name, lab), v))
+                    lines.append("%s %g" % (
+                        _format_key(name + "_sum", m.labels), m.sum))
+                    lines.append("%s %d" % (
+                        _format_key(name + "_count", m.labels), m.count))
+                else:
+                    lines.append("%s %g" % (m.key, m.value))
+        return "\n".join(lines) + "\n"
+
+    # --------------------------------------------------------- device memory
+    def record_device_memory(self) -> None:
+        """Refresh per-device memory gauges from
+        ``jax.local_devices()[*].memory_stats()`` (None on backends that
+        do not report, e.g. CPU)."""
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:  # never force the backend up just to report 0
+            return
+        try:
+            devices = jax.local_devices()
+        except Exception:
+            return
+        for d in devices:
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            lab = {"device": "%s:%d" % (d.platform, d.id)}
+            for stat_key, metric in (
+                    ("bytes_in_use", "device_memory_bytes_in_use"),
+                    ("peak_bytes_in_use", "device_memory_peak_bytes"),
+                    ("bytes_limit", "device_memory_bytes_limit")):
+                if stat_key in stats:
+                    self.get(Gauge, metric, lab).set(stats[stat_key])
+
+
+# ---------------------------------------------------------------------------
+# module-level state + accessors
+# ---------------------------------------------------------------------------
+
+_REG: Optional[Registry] = None
+_state_lock = threading.Lock()
+_atexit_registered = False
+
+
+def enabled() -> bool:
+    return _REG is not None
+
+
+def registry() -> Optional[Registry]:
+    return _REG
+
+
+def enable(jsonl_path: Optional[str] = None) -> Registry:
+    """Turn the registry on (the in-process spelling of ``TP_TELEMETRY=1``)."""
+    global _REG, _atexit_registered
+    with _state_lock:
+        if _REG is None:
+            _REG = Registry(jsonl_path)
+        elif jsonl_path:
+            _REG.jsonl_path = jsonl_path
+        if not _atexit_registered:
+            atexit.register(_at_exit)
+            _atexit_registered = True
+        return _REG
+
+
+def disable() -> None:
+    """Drop the registry; accessors return the no-op singleton again."""
+    global _REG
+    _REG = None
+
+
+def counter(name: str, labels: Optional[Dict[str, str]] = None):
+    r = _REG
+    if r is None:
+        return _NULL
+    return r.get(Counter, name, labels)
+
+
+def gauge(name: str, labels: Optional[Dict[str, str]] = None):
+    r = _REG
+    if r is None:
+        return _NULL
+    return r.get(Gauge, name, labels)
+
+
+def histogram(name: str, labels: Optional[Dict[str, str]] = None):
+    r = _REG
+    if r is None:
+        return _NULL
+    return r.get(Histogram, name, labels)
+
+
+def snapshot() -> Optional[Dict[str, Any]]:
+    r = _REG
+    return r.snapshot() if r is not None else None
+
+
+def flush(path: Optional[str] = None) -> Optional[str]:
+    r = _REG
+    return r.flush(path) if r is not None else None
+
+
+def prometheus_text() -> str:
+    r = _REG
+    return r.prometheus_text() if r is not None else ""
+
+
+def serve(port: int = 9464):
+    """Serve ``prometheus_text()`` at ``/metrics`` from a daemon thread
+    (the Prometheus scrape endpoint).  Returns the HTTPServer."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet scrapes
+            pass
+
+    srv = HTTPServer(("0.0.0.0", port), _Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _at_exit() -> None:
+    r = _REG
+    if r is None:
+        return
+    try:
+        r.flush()
+    except OSError:
+        return
+    if get_env("TELEMETRY_TRACE", True, bool):
+        # one profile.json carrying spans AND the counter time series
+        from . import profiler
+
+        try:
+            profiler.dump_profile()
+        except OSError:
+            pass
+
+
+# env gate (the TP_TELEMETRY=1 contract)
+if get_env("TELEMETRY", False, bool):
+    enable()
+    _port = get_env("TELEMETRY_PORT", 0, int)
+    if _port:
+        serve(_port)

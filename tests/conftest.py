@@ -12,11 +12,20 @@ jax.config API — and it must run before any backend is initialized.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# jax < 0.5 has no jax_num_cpu_devices config option; the XLA flag is the
+# portable spelling and must be in place before the backend initializes
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older jax: XLA_FLAGS above already forced the 8-device mesh
 
 
 def pytest_configure(config):

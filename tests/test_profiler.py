@@ -39,3 +39,54 @@ def test_profiler_scope(tmp_path):
     path = profiler.dump_profile(out)
     names = {e["name"] for e in json.load(open(path))["traceEvents"]}
     assert "my_step" in names
+
+
+def test_profiler_thread_metadata_and_pairing(tmp_path):
+    """Every trace carries M thread_name metadata and B/E pairs per
+    (name, tid) — the contract tools/trace_summary.py relies on."""
+    out = str(tmp_path / "meta.json")
+    profiler.profiler_set_config(mode="all", filename=out)
+    profiler.profiler_set_state("run")
+    x = mx.nd.ones((8, 8))
+    (x + x).asnumpy()
+    profiler.profiler_set_state("stop")
+
+    events = json.load(open(out))["traceEvents"]
+    metas = [e for e in events if e.get("ph") == "M"]
+    assert metas, "expected thread_name metadata events"
+    assert all(e["name"] == "thread_name" for e in metas)
+    assert all("name" in e["args"] for e in metas)
+    # B/E counts match per (name, tid), not just in aggregate
+    from collections import Counter
+
+    begins = Counter((e["name"], e["tid"]) for e in events
+                     if e["ph"] == "B")
+    ends = Counter((e["name"], e["tid"]) for e in events
+                   if e["ph"] == "E")
+    assert begins == ends
+    # span tids all carry metadata
+    span_tids = {e["tid"] for e in events if e["ph"] in ("B", "E")}
+    assert span_tids <= {e["tid"] for e in metas}
+
+
+def test_profiler_mode_symbolic_excludes_engine_ops(tmp_path):
+    """TP_PROFILER_MODE=symbolic drops imperative engine ops; 'all'
+    captures them (env_var.md MXNET_PROFILER_MODE contract)."""
+    out = str(tmp_path / "sym.json")
+    profiler.profiler_set_config(mode="symbolic", filename=out)
+    profiler.profiler_set_state("run")
+    a = mx.nd.ones((8, 8))
+    (a * a).asnumpy()
+    profiler.profiler_set_state("stop")
+    events = json.load(open(out))["traceEvents"]
+    assert not [e for e in events
+                if e.get("ph") == "B" and e.get("cat") == "operator"]
+
+    out2 = str(tmp_path / "all.json")
+    profiler.profiler_set_config(mode="all", filename=out2)
+    profiler.profiler_set_state("run")
+    (a * a).asnumpy()
+    profiler.profiler_set_state("stop")
+    events2 = json.load(open(out2))["traceEvents"]
+    assert [e for e in events2
+            if e.get("ph") == "B" and e.get("cat") == "operator"]

@@ -1,0 +1,316 @@
+"""Unified runtime telemetry: registry semantics, zero-overhead disabled
+path, exposition formats, and the instrumentation wired through lowering /
+executor / module / engine / kvstore / callbacks.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import profiler, telemetry
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture
+def registry(tmp_path):
+    """Fresh enabled registry writing to a tmp JSONL sink."""
+    telemetry.disable()
+    reg = telemetry.enable(str(tmp_path / "telemetry.jsonl"))
+    yield reg
+    telemetry.disable()
+
+
+@pytest.fixture
+def disabled():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# disabled mode: the no-op contract
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_returns_shared_null_singleton(disabled):
+    assert not telemetry.enabled()
+    c = telemetry.counter("a")
+    g = telemetry.gauge("b")
+    h = telemetry.histogram("c", {"k": "v"})
+    assert c is g is h is telemetry._NULL
+    # every mutator is a no-op
+    c.inc()
+    g.set(3)
+    h.observe(0.5)
+    with h.time():
+        pass
+    assert telemetry.snapshot() is None
+    assert telemetry.flush() is None
+    assert telemetry.prometheus_text() == ""
+
+
+def test_disabled_hot_path_allocates_nothing(disabled):
+    """The per-step instrumentation cost when telemetry is off is a few
+    function calls returning one shared singleton — no allocations from
+    telemetry.py at all (the acceptance zero-overhead contract)."""
+    # warm up any lazy interning
+    for _ in range(4):
+        telemetry.counter("warm").inc()
+        telemetry.histogram("warm_h").observe(1.0)
+
+    tracemalloc.start()
+    try:
+        snap0 = tracemalloc.take_snapshot()
+        for _ in range(200):
+            telemetry.counter("steps_total").inc()
+            telemetry.gauge("samples_per_sec").set(1.0)
+            telemetry.histogram("step_latency_seconds").observe(0.01)
+        snap1 = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    stats = snap1.compare_to(snap0, "filename")
+    tele_file = os.path.basename(telemetry.__file__)
+    leaked = [s for s in stats
+              if os.path.basename(s.traceback[0].filename) == tele_file
+              and s.size_diff > 0]
+    assert not leaked, [str(s) for s in leaked]
+
+
+# ---------------------------------------------------------------------------
+# metric types + registry
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics(registry):
+    c = telemetry.counter("req_total")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    # same (name, labels) -> same object
+    assert telemetry.counter("req_total") is c
+
+    g = telemetry.gauge("depth")
+    g.set(7)
+    g.inc(3)
+    g.dec()
+    assert g.value == 9.0
+
+    h = telemetry.histogram("lat")
+    for v in (0.1, 0.2, 0.3, 0.4):
+        h.observe(v)
+    assert h.count == 4
+    assert abs(h.sum - 1.0) < 1e-9
+    assert h.min == 0.1 and h.max == 0.4
+    assert 0.1 <= h.quantile(0.5) <= 0.4
+    with h.time():
+        pass
+    assert h.count == 5
+
+
+def test_labels_key_distinct_and_ordered(registry):
+    a = telemetry.counter("rpc", {"verb": "push"})
+    b = telemetry.counter("rpc", {"verb": "pull"})
+    assert a is not b
+    a.inc()
+    # label insertion order must not split metrics
+    assert telemetry.counter("rpc", {"verb": "push"}) is a
+    assert a.key == 'rpc{verb="push"}'
+
+
+def test_histogram_reservoir_bounded(registry, monkeypatch):
+    h = telemetry.histogram("big")
+    for i in range(5000):
+        h.observe(float(i))
+    assert h.count == 5000
+    assert len(h._reservoir) <= h._cap <= 5000
+
+
+# ---------------------------------------------------------------------------
+# exposition: JSONL, Prometheus text, Chrome trace counters
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_flush_appends_parseable_lines(registry, tmp_path):
+    telemetry.counter("x").inc(2)
+    telemetry.histogram("h").observe(1.5)
+    p1 = telemetry.flush()
+    telemetry.counter("x").inc()
+    p2 = telemetry.flush()
+    assert p1 == p2
+    lines = open(p1).read().strip().splitlines()
+    assert len(lines) == 2
+    snaps = [json.loads(ln) for ln in lines]
+    assert snaps[0]["metrics"]["x"]["value"] == 2
+    assert snaps[1]["metrics"]["x"]["value"] == 3
+    assert snaps[1]["metrics"]["h"]["count"] == 1
+    assert "ts" in snaps[0]
+
+
+def test_prometheus_text_format(registry):
+    telemetry.counter("jobs_total", {"queue": "fast"}).inc(3)
+    telemetry.gauge("depth").set(1.5)
+    h = telemetry.histogram("lat_seconds")
+    for v in (0.1, 0.2):
+        h.observe(v)
+    text = telemetry.prometheus_text()
+    assert "# TYPE jobs_total counter" in text
+    assert 'jobs_total{queue="fast"} 3' in text
+    assert "# TYPE depth gauge" in text
+    assert "# TYPE lat_seconds summary" in text
+    assert 'lat_seconds{quantile="0.5"}' in text
+    assert "lat_seconds_count 2" in text
+
+
+def test_flush_emits_chrome_counter_events(registry, tmp_path):
+    telemetry.counter("flow_total").inc(7)
+    telemetry.gauge("water_level").set(2.5)
+    telemetry.histogram("hist").observe(1.0)
+    telemetry.flush()
+    out = str(tmp_path / "trace.json")
+    profiler.dump_profile(out)
+    events = json.load(open(out))["traceEvents"]
+    cevents = [e for e in events if e.get("ph") == "C"]
+    by_name = {e["name"]: e for e in cevents}
+    assert by_name["flow_total"]["args"]["value"] == 7
+    assert by_name["water_level"]["args"]["value"] == 2.5
+    assert by_name["hist.count"]["args"]["value"] == 1
+    assert all(e["cat"] == "telemetry" for e in cevents)
+
+
+# ---------------------------------------------------------------------------
+# wired instrumentation
+# ---------------------------------------------------------------------------
+
+
+def test_engine_dispatch_counters(registry):
+    before = telemetry.counter("engine_dispatch_total").value
+    (mx.nd.ones((4, 4)) * 2).asnumpy()
+    assert telemetry.counter("engine_dispatch_total").value > before
+
+
+def test_lowering_cache_hit_and_compile_metrics(registry):
+    net = mx.models.mlp()
+    e1 = net.simple_bind(ctx=mx.cpu(), data=(2, 784))
+    e1.forward(is_train=False,
+               data=np.zeros((2, 784), np.float32),
+               softmax_label=np.zeros(2, np.float32))
+    misses = telemetry.counter("lowering_cache_misses_total").value
+    assert misses >= 1
+    assert telemetry.counter("jit_compile_total").value >= 1
+    assert telemetry.histogram("lowering_seconds").count >= 1
+    # second executor over the SAME symbol reuses the lowered fn
+    e2 = net.simple_bind(ctx=mx.cpu(), data=(2, 784))
+    e2.forward(is_train=False,
+               data=np.zeros((2, 784), np.float32),
+               softmax_label=np.zeros(2, np.float32))
+    assert telemetry.counter("lowering_cache_hits_total").value >= 1
+    assert telemetry.counter("lowering_cache_misses_total").value == misses
+
+
+def test_module_fit_step_metrics(registry, tmp_path):
+    train = mx.io.MNISTIter(batch_size=32, shuffle=True, num_examples=128,
+                            seed=0)
+    mod = mx.mod.Module(mx.models.mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01},
+            batch_end_callback=mx.callback.Speedometer(32, 2))
+    assert telemetry.histogram("step_latency_seconds").count >= 4
+    assert telemetry.counter("steps_total").value >= 4
+    assert telemetry.counter("samples_total").value >= 128
+    assert telemetry.counter("epochs_total").value == 1
+    assert telemetry.gauge("samples_per_sec").value > 0
+    assert telemetry.gauge("speedometer_samples_per_sec").value > 0
+    # fit flushed at epoch end -> JSONL sink has at least one snapshot
+    lines = open(telemetry.registry().jsonl_path).read().strip()
+    assert lines
+    snap = json.loads(lines.splitlines()[-1])
+    assert snap["metrics"]["step_latency_seconds"]["count"] >= 4
+    assert snap["metrics"]["jit_compile_total"]["value"] >= 1
+
+
+def test_kvstore_local_counters(registry):
+    kv = mx.kv.create("local")
+    v = mx.nd.ones((8,))
+    kv.init("w", v)
+    kv.push("w", mx.nd.ones((8,)))
+    out = mx.nd.zeros((8,))
+    kv.pull("w", out=out)
+    assert telemetry.counter("kvstore_push_total").value == 1
+    assert telemetry.counter("kvstore_pull_total").value == 1
+    assert telemetry.counter("kvstore_push_bytes_total").value == 32
+    assert telemetry.counter("kvstore_pull_bytes_total").value == 32
+
+
+def test_speedometer_survives_zero_elapsed(monkeypatch, disabled):
+    """Two callback firings inside one timer tick must not raise
+    ZeroDivisionError (the time.monotonic + clamp fix)."""
+    import incubator_mxnet_tpu.callback as cb
+
+    monkeypatch.setattr(cb.time, "monotonic", lambda: 42.0)
+    sp = mx.callback.Speedometer(batch_size=4, frequent=1)
+
+    class _P:
+        epoch = 0
+        eval_metric = None
+
+    p = _P()
+    p.nbatch = 0
+    sp(p)  # initializes tic
+    p.nbatch = 1
+    sp(p)  # elapsed == 0.0 -> clamped, no raise
+
+
+# ---------------------------------------------------------------------------
+# PS cluster counters (slow: spawns scheduler+server subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_ps_rpc_counters(registry):
+    from incubator_mxnet_tpu import ps
+
+    node = os.path.join(HERE, "dist", "ps_node.py")
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, node, "scheduler", "1", "1", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env),
+        subprocess.Popen(
+        [sys.executable, node, "server", "0", "1", "127.0.0.1", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)]
+    try:
+        c = ps.PSClient(0, scheduler=("127.0.0.1", port))
+        w = np.arange(8, dtype=np.float32)
+        c.init("w", w)
+        c.push("w", w)
+        # no updater configured: the server stores the pushed value as-is
+        np.testing.assert_array_equal(c.pull("w", w), w)
+        push_c = telemetry.counter("ps_rpc_total", {"verb": "push"})
+        pull_c = telemetry.counter("ps_rpc_total", {"verb": "pull"})
+        assert push_c.value >= 1
+        assert pull_c.value >= 1
+        assert telemetry.counter("ps_rpc_bytes_total",
+                                 {"verb": "push"}).value >= w.nbytes
+        assert telemetry.histogram(
+            "ps_rpc_seconds", {"verb": "push"}).count >= 1
+        c.finalize()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=30)

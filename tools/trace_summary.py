@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Summarize a Chrome trace-event ``profile.json``.
+
+Works on the files ``profiler.dump_profile()`` writes: paired ``B``/``E``
+span events, ``X`` complete events, ``C`` counter events (telemetry), and
+``M`` thread_name metadata.  Stdlib only.
+
+Usage::
+
+    python tools/trace_summary.py profile.json [--top 15]
+
+Prints the top-N ops by total and self time (self = total minus time
+spent in nested spans on the same thread), per-thread span counts, and
+the last value + sample count of every counter series.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_events(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        return doc.get("traceEvents", [])
+    return doc  # bare-array trace format
+
+
+def summarize(events):
+    """-> (op_stats, thread_counts, counters, thread_names)
+
+    op_stats: name -> {"count", "total_us", "self_us"}
+    """
+    op_stats = defaultdict(lambda: {"count": 0, "total_us": 0.0,
+                                    "self_us": 0.0})
+    thread_counts = defaultdict(int)
+    counters = {}
+    thread_names = {}
+
+    spans = [e for e in events if e.get("ph") in ("B", "E", "X")]
+    # stable sort by timestamp keeps B-before-E for zero-length spans
+    spans.sort(key=lambda e: e.get("ts", 0.0))
+
+    # per-thread stacks: [name, t0, child_acc]
+    stacks = defaultdict(list)
+
+    def close(tid, name, t0, t1, child_acc):
+        dur = max(0.0, t1 - t0)
+        st = op_stats[name]
+        st["count"] += 1
+        st["total_us"] += dur
+        st["self_us"] += max(0.0, dur - child_acc)
+        thread_counts[tid] += 1
+        if stacks[tid]:
+            stacks[tid][-1][2] += dur  # credit parent with nested time
+
+    for e in spans:
+        tid = e.get("tid", 0)
+        ph = e["ph"]
+        if ph == "B":
+            stacks[tid].append([e.get("name", "?"), e.get("ts", 0.0), 0.0])
+        elif ph == "E":
+            if not stacks[tid]:
+                continue  # unmatched E: drop rather than crash
+            name, t0, child_acc = stacks[tid].pop()
+            close(tid, name, t0, e.get("ts", t0), child_acc)
+        else:  # X: complete event, duration in "dur"
+            t0 = e.get("ts", 0.0)
+            close(tid, e.get("name", "?"), t0, t0 + e.get("dur", 0.0), 0.0)
+
+    for e in events:
+        ph = e.get("ph")
+        if ph == "C":
+            name = e.get("name", "?")
+            c = counters.setdefault(name, {"samples": 0, "last": None})
+            c["samples"] += 1
+            c["last"] = e.get("args", {}).get("value")
+        elif ph == "M" and e.get("name") == "thread_name":
+            thread_names[e.get("tid", 0)] = \
+                e.get("args", {}).get("name", "?")
+
+    return op_stats, thread_counts, counters, thread_names
+
+
+def _fmt_us(us):
+    if us >= 1e6:
+        return "%.3f s" % (us / 1e6)
+    if us >= 1e3:
+        return "%.3f ms" % (us / 1e3)
+    return "%.1f us" % us
+
+
+def print_report(op_stats, thread_counts, counters, thread_names,
+                 top=15, out=sys.stdout):
+    def table(title, key):
+        rows = sorted(op_stats.items(), key=lambda kv: -kv[1][key])[:top]
+        out.write("\n%s (top %d)\n" % (title, top))
+        out.write("%-48s %8s %14s %14s\n"
+                  % ("name", "count", "total", "self"))
+        for name, st in rows:
+            out.write("%-48s %8d %14s %14s\n"
+                      % (name[:48], st["count"], _fmt_us(st["total_us"]),
+                         _fmt_us(st["self_us"])))
+
+    if op_stats:
+        table("Ops by total time", "total_us")
+        table("Ops by self time", "self_us")
+    else:
+        out.write("\nno span events\n")
+
+    if thread_counts:
+        out.write("\nSpans per thread\n")
+        for tid in sorted(thread_counts):
+            label = thread_names.get(tid, str(tid))
+            out.write("%-32s %8d\n" % (label, thread_counts[tid]))
+
+    if counters:
+        out.write("\nCounter series (telemetry)\n")
+        out.write("%-48s %8s %16s\n" % ("name", "samples", "last"))
+        for name in sorted(counters):
+            c = counters[name]
+            out.write("%-48s %8d %16s\n" % (name[:48], c["samples"],
+                                            c["last"]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome trace-event JSON file")
+    ap.add_argument("--top", type=int, default=15,
+                    help="rows per span table (default 15)")
+    args = ap.parse_args(argv)
+    events = load_events(args.trace)
+    print_report(*summarize(events), top=args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
