@@ -171,6 +171,23 @@ def main():
             k: tuned[k] for k in ("value", "model_tflops_per_sec",
                                   "mfu_vs_sustained", "mfu_vs_peak",
                                   "opt_state_dtype", "grad_dtype")}
+    # ZeRO-1 A/B (docs/zero.md): the same flagship step with adam m/v
+    # sharded over a dp mesh (all local devices whose count divides the
+    # batch).  A sub-record like the bf16 one — defaults keep the
+    # unsharded headline untouched.
+    import jax
+
+    zdp = max(d for d in (1, 2, 4, 8)
+              if d <= jax.device_count() and lm["batch"] % d == 0)
+    zero = bench_lm.run(defaults=dict(
+        lm_defaults, TP_LM_SHARD_OPT=1, TP_LM_DP=zdp))
+    combined["shard_optimizer"] = {
+        k: zero[k] for k in ("value", "model_tflops_per_sec",
+                             "mfu_vs_sustained", "mesh_dp",
+                             "shard_optimizer",
+                             "opt_state_bytes_per_device")}
+    combined["opt_state_bytes_per_device"] = \
+        lm["opt_state_bytes_per_device"]
     # vs_baseline keeps the ResNet-vs-P100 anchor (BASELINE.md has no
     # reference LM throughput to anchor tokens/s against); the nested
     # record carries its full provenance

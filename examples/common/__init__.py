@@ -14,9 +14,20 @@ if _REPO not in sys.path:
 if os.environ.get("TP_EXAMPLES_FORCE_CPU") == "1":
     # the axon TPU plugin ignores JAX_PLATFORMS=cpu; tests force the CPU
     # backend via the config API before jax initializes (tests/conftest.py)
+    _n = int(os.environ.get("TP_EXAMPLES_CPU_DEVICES", "0"))
+    if _n > 1 and "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # portable spelling for jax < 0.5 (no jax_num_cpu_devices option);
+        # must be set before the backend initializes
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=%d" % _n)
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    _n = int(os.environ.get("TP_EXAMPLES_CPU_DEVICES", "0"))
     if _n > 1:  # virtual device mesh for --pipeline / multi-device runs
-        jax.config.update("jax_num_cpu_devices", _n)
+        try:
+            jax.config.update("jax_num_cpu_devices", _n)
+        except AttributeError:
+            pass  # older jax: XLA_FLAGS above already forced the mesh

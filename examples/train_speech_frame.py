@@ -58,7 +58,12 @@ def acoustic_model(frames, bins, phones, hidden, layers):
     flat = mx.sym.Reshape(outputs, shape=(-1, 2 * hidden))
     fc = mx.sym.FullyConnected(flat, num_hidden=phones, name="cls")
     lab = mx.sym.Reshape(label, shape=(-1,))
-    return mx.sym.SoftmaxOutput(fc, lab, name="softmax")
+    sm = mx.sym.SoftmaxOutput(fc, lab, name="softmax")
+    # fold the time axis back so predictions are (B, frames, phones)
+    # against (B, frames) labels — the Accuracy metric argmaxes only
+    # when the prediction carries an extra class axis (metric.py)
+    return mx.sym.Reshape(sm, shape=(-1, frames, phones),
+                          name="framewise")
 
 
 def main():
@@ -92,12 +97,12 @@ def main():
             optimizer_params={"learning_rate": args.lr},
             initializer=mx.initializer.Xavier(factor_type="in",
                                               magnitude=2.34),
-            eval_metric="acc")
+            eval_metric=mx.metric.Accuracy(axis=-1))
 
-    # framewise accuracy on the training distribution, predict mode
-    # (the Accuracy metric counts (B, T) labels against (B*T, C)
-    # scores flat — reference metric.py:391 semantics)
-    acc = mod.score(it, "acc")[0][1]
+    # framewise accuracy on the training distribution, predict mode:
+    # (B, T, C) scores argmax over the trailing class axis against
+    # (B, T) labels (reference metric.py:391 ndim semantics)
+    acc = mod.score(it, mx.metric.Accuracy(axis=-1))[0][1]
     logging.info("frame-accuracy=%.4f", acc)
     assert acc > 0.85, "acoustic model under-trained: %.4f" % acc
     print("done")

@@ -417,7 +417,9 @@ def _build_psum(devices, shape, dtype):
     import jax
     import numpy as _np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from .parallel.mesh import shard_map_fn
+
+    shard_map = shard_map_fn()
 
     mesh = Mesh(_np.asarray(devices), ("dev",))
     in_sharding = NamedSharding(mesh, P("dev"))
@@ -455,7 +457,9 @@ def _build_process_psum(shape, dtype):
     import jax
     import numpy as _np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from .parallel.mesh import shard_map_fn
+
+    shard_map = shard_map_fn()
 
     procs = jax.process_count()
     by_proc = {}

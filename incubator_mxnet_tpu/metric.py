@@ -115,10 +115,11 @@ class Accuracy(EvalMetric):
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
             pred, label = _as_np(pred), _as_np(label)
-            # reference condition (metric.py:391): ANY shape mismatch
-            # argmaxes — framewise labels (B, T) against (B*T, C)
-            # class scores count flat, not just the ndim>label case
-            if pred.shape != label.shape:
+            # reference condition (metric.py:391): argmax only when the
+            # prediction carries an extra class axis.  Same-rank shape
+            # mismatches fall through to check_label_shapes below and
+            # raise instead of being silently argmaxed into nonsense.
+            if pred.ndim > label.ndim:
                 pred = pred.argmax(axis=self.axis)
             pred = pred.astype(np.int32).reshape(-1)
             label = label.astype(np.int32).reshape(-1)

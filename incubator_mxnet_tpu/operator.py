@@ -145,7 +145,15 @@ def _custom_infer_shape(in_shapes, attrs):
             ins, outs, auxs = prop.infer_shape(
                 [list(s) if s is not None else None
                  for s in in_shapes[:n_args]])
-        except Exception:
+        except (TypeError, IndexError, KeyError) as e:
+            # only the failure modes of a prop poking into still-None
+            # shapes; anything else (a genuine bug in the prop) must
+            # surface, not dissolve into "shape unknown"
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "partial infer_shape for %s deferred: %s",
+                attrs.get("op_type"), e)
             return in_shapes, [None] * len(prop.list_outputs()), []
         return [tuple(s) if s is not None else None for s in ins], \
             [tuple(s) if s is not None else None for s in outs], \

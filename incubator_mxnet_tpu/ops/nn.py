@@ -431,6 +431,33 @@ def _softmax_output(ins, attrs, ctx):
     return fn(ins[0], ins[1])
 
 
+def _softmax_cross_entropy_infer_shape(in_shapes, attrs):
+    data_s = in_shapes[0]
+    if data_s is None:
+        return in_shapes, [(1,)], []
+    return [data_s, in_shapes[1] or (data_s[0],)], [(1,)], []
+
+
+@register("softmax_cross_entropy", arg_names=["data", "label"],
+          aliases=["SoftmaxCrossEntropy"],
+          infer_shape=_softmax_cross_entropy_infer_shape)
+def _softmax_cross_entropy(ins, attrs, ctx):
+    """Summed cross-entropy of softmax(data) against integer labels.
+
+    Reference: ``src/operator/loss_binary_op.cc:29`` — output is the
+    (1,)-shaped TOTAL batch loss; the gradient of the composition is
+    the usual ``softmax(data) - onehot(label)``, which plain jax
+    autodiff of log-softmax gather recovers (labels flow through an
+    integer cast, so they get no gradient, matching the reference's
+    label grad of zero).
+    """
+    data, label = ins
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.reshape(-1).astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return -jnp.sum(picked).reshape(1)
+
+
 # ---------------------------------------------------------------------------
 # Fused chunked softmax-cross-entropy head
 # ---------------------------------------------------------------------------

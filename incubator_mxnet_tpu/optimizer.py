@@ -497,3 +497,31 @@ class Updater:
 
 def get_updater(optimizer: Optimizer) -> Updater:
     return Updater(optimizer)
+
+
+# ---------------------------------------------------------------------------
+# Fused-step update resolution — shared by FusedTrainStep and
+# SymbolPipelineTrainStep (previously duplicated in both)
+# ---------------------------------------------------------------------------
+
+# optimizer name → (update op from ops/optimizer_ops.py, #state tensors)
+FUSED_UPDATE_OPS = {
+    "adam": ("adam_update", 2),
+    "rmsprop": ("rmsprop_update", 1),
+    "nag": ("nag_mom_update", 1),
+    "ftrl": ("ftrl_update", 2),
+}
+
+
+def fused_update_plan(optimizer: str, opt_params: Dict[str, Any]):
+    """Resolve ``optimizer`` to ``(update_op_name, n_states)`` for the
+    one-program train steps, or None when unsupported.  ``sgd``
+    dispatches on momentum (and drops the momentum attr when 0, like
+    the reference's sgd_update/sgd_mom_update split); ``opt_params`` is
+    mutated accordingly."""
+    if optimizer == "sgd":
+        if float(opt_params.get("momentum", 0.0)) != 0.0:
+            return "sgd_mom_update", 1
+        opt_params.pop("momentum", None)
+        return "sgd_update", 0
+    return FUSED_UPDATE_OPS.get(optimizer)

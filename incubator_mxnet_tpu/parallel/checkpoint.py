@@ -12,6 +12,13 @@ State saved: params, optimizer states, aux (BN moving stats), and
 ``num_update`` — everything `FusedTrainStep` (or, via its
 stage-stacked flat buffers, `SymbolPipelineTrainStep`) needs to
 resume bit-exact.
+
+Restore is *resharding*: the target layout comes from the live step's
+arrays, not the checkpoint.  A checkpoint written with replicated
+optimizer state restores cleanly onto a ``shard_optimizer=True`` step
+(each device reads just its ZeRO shard) and vice versa, so flipping
+ZeRO-1 on or off mid-training-run is a resume, not a migration
+(asserted by ``tests/test_zero.py``).
 """
 from __future__ import annotations
 

@@ -12,7 +12,8 @@ from typing import Optional, Sequence
 from .. import telemetry
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "ring_permute",
-           "barrier_sync"]
+           "barrier_sync", "reduce_scatter_constraint",
+           "all_gather_constraint"]
 
 _KIND_LABELS = {}
 
@@ -66,13 +67,40 @@ def reduce_scatter(x, axis_name: str = "dp", scatter_dimension: int = 0):
                                 tiled=True)
 
 
+def reduce_scatter_constraint(x, sharding):
+    """GSPMD spelling of a reduce-scatter (the ZeRO-1 gradient path,
+    ``parallel/zero.py``): force a value that carries a pending dp-sum
+    into the sharded state layout.  XLA combines the gradient psum with
+    the slice into ONE reduce-scatter, so each device receives only the
+    shard it owns — 1/dp of the all-reduce bytes.  Runs inside pjit
+    tracing; counted once per compiled program like the shard_map
+    wrappers above."""
+    import jax
+
+    _count("reduce_scatter", x)
+    return jax.lax.with_sharding_constraint(x, sharding)
+
+
+def all_gather_constraint(x, sharding):
+    """GSPMD spelling of an all-gather: force a state-sharded value
+    (the ZeRO-updated parameter shard) back into its parameter layout;
+    XLA inserts the all-gather that rebuilds the full tensor on every
+    device."""
+    import jax
+
+    _count("all_gather", x)
+    return jax.lax.with_sharding_constraint(x, sharding)
+
+
 def ring_permute(x, axis_name: str, shift: int = 1):
     """Send shard to the next device on the ring (ring-attention /
     pipeline building block)."""
     import jax
 
     _count("ring_permute", x)
-    n = jax.lax.axis_size(axis_name)
+    from .mesh import axis_size as _axis_size
+
+    n = _axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name, perm)
 

@@ -10,6 +10,13 @@ priorities (``kvstore_dist.h`` negative-key priorities).
 
 Params/optimizer-states/aux live donated on-device; the learning rate is a
 dynamic scalar input so schedules don't retrigger compilation.
+
+``shard_optimizer=True`` adds ZeRO-1 optimizer-state sharding
+(``parallel/zero.py``, reference analog: per-server key-range updates in
+``kvstore_dist_server.h:105-230``): each param's m/v/momentum live split
+over the dp (and ep) axes, gradients reduce-scatter into the owned
+shard, the update runs shard-local, and updated params all-gather back —
+the per-device state footprint drops to ~1/dp of the replicated layout.
 """
 from __future__ import annotations
 
@@ -26,14 +33,10 @@ from .mesh import (data_parallel_spec, default_mesh, replicated_spec)
 __all__ = ["FusedTrainStep"]
 
 
-# optimizer name → (update op, #states) — ops from ops/optimizer_ops.py
-_FUSED_OPTS = {
-    "sgd": None,  # resolved to sgd_update / sgd_mom_update by momentum
-    "adam": ("adam_update", 2),
-    "rmsprop": ("rmsprop_update", 1),
-    "nag": ("nag_mom_update", 1),
-    "ftrl": ("ftrl_update", 2),
-}
+# optimizer name → (update op, #states); the resolution itself
+# (momentum-dispatched sgd included) lives in optimizer.fused_update_plan
+from ..optimizer import FUSED_UPDATE_OPS as _FUSED_OPTS
+from ..optimizer import fused_update_plan as _fused_update_plan
 
 
 from ..lowering import lower_symbol as _lower_symbol  # shared lowering
@@ -174,7 +177,8 @@ class FusedTrainStep:
                  param_partition: Optional[Dict[str, Any]] = None,
                  flat_optimizer: bool = False, remat=None,
                  grad_accum: Optional[int] = None,
-                 opt_state_dtype=None, grad_dtype=None):
+                 opt_state_dtype=None, grad_dtype=None,
+                 shard_optimizer: Optional[bool] = None):
         import jax
         import jax.numpy as jnp
 
@@ -263,18 +267,11 @@ class FusedTrainStep:
         opt_params = dict(optimizer_params or {})
         self.lr = float(opt_params.pop("learning_rate", 0.01))
         self.lr_scheduler = opt_params.pop("lr_scheduler", None)
-        momentum = float(opt_params.get("momentum", 0.0))
-        if optimizer == "sgd":
-            if momentum != 0.0:
-                self._opt_op, self._n_states = "sgd_mom_update", 1
-            else:
-                self._opt_op, self._n_states = "sgd_update", 0
-                opt_params.pop("momentum", None)
-        elif optimizer in _FUSED_OPTS:
-            self._opt_op, self._n_states = _FUSED_OPTS[optimizer]
-        else:
+        plan_upd = _fused_update_plan(optimizer, opt_params)
+        if plan_upd is None:
             raise MXNetError("FusedTrainStep does not support optimizer %s"
                              % optimizer)
+        self._opt_op, self._n_states = plan_upd
         opt_params.setdefault("rescale_grad", 1.0 / self.global_batch)
         self._opt_attrs = opt_params
         # flat mode: one fused update over the concatenation of every
@@ -290,6 +287,18 @@ class FusedTrainStep:
             raise MXNetError("flat_optimizer is incompatible with "
                              "opt_state_dtype")
         self._flat_opt = bool(flat_optimizer)
+        # ZeRO-1 optimizer-state sharding (parallel/zero.py): each
+        # param's state lives split over the dp (and, composing with
+        # expert sharding, ep) mesh axes.  The TP_SHARD_OPTIMIZER env
+        # applies only when the caller did not specify.
+        if shard_optimizer is None:
+            shard_optimizer = bool(get_env("SHARD_OPTIMIZER", 0, int))
+        if shard_optimizer and flat_optimizer:
+            raise MXNetError("flat_optimizer is incompatible with "
+                             "shard_optimizer (the flat 1-D buffer has "
+                             "no per-tensor state sharding)")
+        # plain sgd holds no state — nothing to shard
+        self._zero = bool(shard_optimizer) and self._n_states > 0
         self.num_update = 0
 
         # ---- parameter init (host, then shard) --------------------------
@@ -308,6 +317,25 @@ class FusedTrainStep:
                     self.mesh, spec)
             else:
                 self._param_sharding[n] = rep
+
+        # optimizer-state shardings: the param's own placement, plus —
+        # under ZeRO — the dp/ep axes folded onto the first divisible
+        # free dim (zero_state_spec).  Params with no shardable dim
+        # (scalars, nothing divisible) keep replicated state.
+        from .zero import zero_state_spec
+
+        self._state_sharding = dict(self._param_sharding)
+        self._zero_names = set()
+        if self._zero:
+            mesh_axes = dict(self.mesh.shape)
+            for n in self.param_names:
+                zspec = zero_state_spec(
+                    mesh_axes, (param_partition or {}).get(n),
+                    tuple(shape_of[n]), shard_axes=("dp", "ep"))
+                if zspec is not None:
+                    self._state_sharding[n] = jax.sharding.NamedSharding(
+                        self.mesh, zspec)
+                    self._zero_names.add(n)
 
         var_attrs = {node.name: (node.attrs or {})
                      for node in symbol.topo_nodes() if node.is_variable}
@@ -389,13 +417,14 @@ class FusedTrainStep:
                         for _ in range(self._n_states))
                     for n in self.param_names}
 
-            out_sh = {n: tuple(self._param_sharding[n]
+            out_sh = {n: tuple(self._state_sharding[n]
                                for _ in range(self._n_states))
                       for n in self.param_names}
             self.opt_states = jax.jit(
                 make_states, out_shardings=out_sh)()
         else:
             self.opt_states = {n: () for n in self.param_names}
+        self.optimizer_state_bytes()  # publish the footprint gauges
         self._key = jax.random.PRNGKey(seed)
         self._step_fn = self._build(shapes)
 
@@ -404,11 +433,17 @@ class FusedTrainStep:
         import jax
         import jax.numpy as jnp
 
+        from .collectives import (all_gather_constraint,
+                                  reduce_scatter_constraint)
+
         telemetry.counter("jit_compile_total").inc()
         fwd = _lower_symbol(self.symbol, is_train=True, remat=self.remat)
         opt_op = get_op(self._opt_op)
         opt_attrs = dict(self._opt_attrs)
         n_states = self._n_states
+        zero_names = frozenset(self._zero_names)
+        state_sharding = dict(self._state_sharding)
+        param_sharding = dict(self._param_sharding)
 
         adam_b1 = float(opt_attrs.get("beta1", 0.9))
         adam_b2 = float(opt_attrs.get("beta2", 0.999))
@@ -515,9 +550,21 @@ class FusedTrainStep:
                     # low-precision stored states: upcast for the
                     # update math, downcast on store
                     sts = [s.astype(w.dtype) for s in opt_states[name]]
+                    if name in zero_names:
+                        # ZeRO-1: the pending dp-sum gradient lands
+                        # reduce-scattered in the state layout, the
+                        # update runs on the owned shard only, and the
+                        # new param all-gathers back to its placement
+                        ssh = state_sharding[name]
+                        g = reduce_scatter_constraint(g, ssh)
+                        w = jax.lax.with_sharding_constraint(w, ssh)
                     res, _ = opt_op.apply([w, g] + sts,
                                           attrs, OpContext(is_train=True))
-                    new_params[name] = res[0]
+                    if name in zero_names:
+                        new_params[name] = all_gather_constraint(
+                            res[0], param_sharding[name])
+                    else:
+                        new_params[name] = res[0]
                     new_states[name] = tuple(
                         r.astype(s.dtype) for r, s in
                         zip(res[1:1 + n_states], opt_states[name]))
@@ -528,7 +575,7 @@ class FusedTrainStep:
 
         batch_shardings = {n: dp(len(s)) for n, s in shapes.items()}
         param_sh = dict(self._param_sharding)
-        state_sh = {n: tuple(self._param_sharding[n]
+        state_sh = {n: tuple(self._state_sharding[n]
                              for _ in range(n_states))
                     for n in self.params}
         aux_sh = {n: rep for n in self.aux}
@@ -577,6 +624,16 @@ class FusedTrainStep:
         """
         name = min(self.params, key=lambda n: self.params[n].size)
         return float(np.asarray(self.params[name]).ravel()[0])
+
+    # -------------------------------------------------------------- state
+    def optimizer_state_bytes(self):
+        """``(logical_total, per_device)`` bytes of the optimizer state;
+        refreshes the ``optimizer_state_bytes_*`` telemetry gauges.
+        Under ``shard_optimizer`` the per-device share is ~1/dp (and
+        1/ep for expert params) of the replicated footprint."""
+        from .zero import publish_state_gauges
+
+        return publish_state_gauges(self.opt_states, "fused")
 
     # ------------------------------------------------------------- params
     def get_params(self):

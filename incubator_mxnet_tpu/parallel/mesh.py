@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Dict, Optional, Sequence, Tuple
 
 __all__ = ["build_mesh", "default_mesh", "data_parallel_spec",
-           "replicated_spec"]
+           "replicated_spec", "axis_size"]
 
 
 def build_mesh(axes: Dict[str, int], devices=None):
@@ -67,3 +67,17 @@ def shard_map_fn():
         from jax.experimental.shard_map import shard_map
 
         return shard_map
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis from inside shard_map tracing.
+
+    ``lax.axis_size`` with fallback for jax builds that predate it:
+    ``lax.psum(1, axis)`` on a Python literal takes the constant fast
+    path and returns the axis size as a plain int, so the result is
+    always static (usable for ``range``/``ppermute`` perm lists)."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)

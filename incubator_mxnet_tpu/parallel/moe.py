@@ -20,6 +20,8 @@ import functools
 
 import numpy as np
 
+from .mesh import axis_size as _axis_size
+
 __all__ = ["moe_ffn", "expert_parallel_moe"]
 
 
@@ -53,7 +55,7 @@ def moe_ffn(x, gate_w, w1, w2, axis_name: str = "ep", top_k: int = 2,
     from ..ops._moe_routing import (route, sparse_combine,
                                     sparse_dispatch)
 
-    E = lax.axis_size(axis_name)
+    E = _axis_size(axis_name)
     T, d = x.shape
     logits = x @ gate_w                          # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)

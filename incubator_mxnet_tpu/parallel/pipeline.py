@@ -31,6 +31,8 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
+from .mesh import axis_size as _axis_size
+
 __all__ = ["pipeline_apply", "pipeline_parallel_apply",
            "PipelineTrainStep"]
 
@@ -50,7 +52,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
     import jax.numpy as jnp
     from jax import lax
 
-    L = lax.axis_size(axis_name)
+    L = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     M = x_microbatches.shape[0]
     perm = [(i, i + 1) for i in range(L - 1)]  # no wraparound: a chain
@@ -125,8 +127,15 @@ def _build_pipeline(mesh, stage_fn, axis_name, params_treedef):
 
     spec_p = jax.tree.unflatten(
         params_treedef, [P(axis_name)] * params_treedef.num_leaves)
+    kwargs = {}
+    from jax import lax
+    if not hasattr(lax, "pcast"):
+        # pre-pcast jax cannot mark the scan carries device-varying (see
+        # pipeline_apply) and its replication checker then rejects them
+        # under grad — disable the check, per jax's own suggestion
+        kwargs["check_rep"] = False
     fn = shard_map_fn()(body, mesh=mesh,
-                        in_specs=(spec_p, P()), out_specs=P())
+                        in_specs=(spec_p, P()), out_specs=P(), **kwargs)
     return jax.jit(fn)
 
 
@@ -372,7 +381,7 @@ class PipelineTrainStep:
 
         def pipeline_loss(params, tokens, labels):
             # inside shard_map: block leaves are (layers/L, ...) local
-            L = lax.axis_size(axis)
+            L = _axis_size(axis)
             idx = lax.axis_index(axis)
             bp = {l: params[l] for l in block_leaves}
             tok_w = params["tok_embed_weight"]

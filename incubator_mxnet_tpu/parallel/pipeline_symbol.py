@@ -306,10 +306,12 @@ class SymbolPipelineTrainStep:
                  axis_name: str = "pp",
                  optimizer: str = "sgd",
                  optimizer_params: Optional[Dict[str, Any]] = None,
-                 initializer=None, seed: int = 0):
+                 initializer=None, seed: int = 0,
+                 shard_optimizer: Optional[bool] = None):
         import jax
 
-        from .fused import _FUSED_OPTS, _device_init_plan
+        from ..optimizer import fused_update_plan as _fused_update_plan
+        from .fused import _device_init_plan
         from .mesh import default_mesh
 
         self.symbol = symbol
@@ -352,22 +354,29 @@ class SymbolPipelineTrainStep:
         opt_params = dict(optimizer_params or {})
         self.lr = float(opt_params.pop("learning_rate", 0.01))
         self.lr_scheduler = opt_params.pop("lr_scheduler", None)
-        momentum = float(opt_params.get("momentum", 0.0))
-        if optimizer == "sgd":
-            if momentum != 0.0:
-                self._opt_op, self._n_states = "sgd_mom_update", 1
-            else:
-                self._opt_op, self._n_states = "sgd_update", 0
-                opt_params.pop("momentum", None)
-        elif optimizer in _FUSED_OPTS:
-            self._opt_op, self._n_states = _FUSED_OPTS[optimizer]
-        else:
+        plan_upd = _fused_update_plan(optimizer, opt_params)
+        if plan_upd is None:
             raise MXNetError(
                 "SymbolPipelineTrainStep does not support optimizer %s"
                 % optimizer)
+        self._opt_op, self._n_states = plan_upd
         opt_params.setdefault("rescale_grad", 1.0 / self.global_batch)
         self._opt_attrs = opt_params
         self.num_update = 0
+
+        # ZeRO-1 (parallel/zero.py): optimizer state for the stage-
+        # stacked (L, maxP) flat buffers additionally shards the maxP
+        # dim over the data axes — each dp replica owns 1/ndp of every
+        # stage's m/v/momentum.  Requires maxP % ndp == 0, so the flat
+        # layout pads up (the tail was already zero-padding).  The
+        # TP_SHARD_OPTIMIZER env applies when the caller did not say.
+        if shard_optimizer is None:
+            shard_optimizer = bool(get_env("SHARD_OPTIMIZER", 0, int))
+        self._zero = bool(shard_optimizer) and self._n_states > 0 \
+            and ndp > 1
+        if self._zero:
+            self._plan["max_psize"] = \
+                -(-self._plan["max_psize"] // ndp) * ndp
 
         # ---- parameters: per-stage flat rows, on-chip init -----------
         from ..initializer import InitDesc, Uniform
@@ -378,6 +387,11 @@ class SymbolPipelineTrainStep:
         P = jax.sharding.PartitionSpec
         self._stack_sh = jax.sharding.NamedSharding(self.mesh,
                                                     P(axis_name))
+        # optimizer-state layout: stage rows over pp, and under ZeRO
+        # the flat maxP dim split over every data axis
+        self._state_sh = self._stack_sh if not self._zero else \
+            jax.sharding.NamedSharding(
+                self.mesh, P(axis_name, tuple(self._data_axes)))
         var_attrs = {node.name: (node.attrs or {})
                      for node in plan["nodes"] if node.is_variable}
         all_named = [(n, tuple(plan["shape_of"][n]), var_attrs.get(n))
@@ -438,10 +452,11 @@ class SymbolPipelineTrainStep:
             self.opt_states = jax.jit(
                 lambda: tuple(jnp.zeros((L, maxP), jnp.float32)
                               for _ in range(self._n_states)),
-                out_shardings=tuple(self._stack_sh
+                out_shardings=tuple(self._state_sh
                                     for _ in range(self._n_states)))()
         else:
             self.opt_states = ()
+        self.optimizer_state_bytes()  # publish the footprint gauges
         self._key = jax.random.PRNGKey(seed + 1)
         self._step_fn = self._build()
 
@@ -504,9 +519,12 @@ class SymbolPipelineTrainStep:
                         .reshape(-1)
                     state_out = jnp.zeros((maxB,), jnp.float32) \
                         .at[:sz].set(y)
-                    loss = jnp.float32(0.0)
+                    # loss stays rank-1: jax 0.4.x shard_map partial-eval
+                    # assigns residuals a dim-0 mesh name, which a rank-0
+                    # residual cannot carry (_check_names _SpecError)
+                    loss = jnp.zeros((1,), jnp.float32)
                 else:
-                    loss = jnp.float32(0.0)
+                    loss = jnp.zeros((1,), jnp.float32)
                     for (pos, i) in out_entries:
                         node = plan["nodes"][pos]
                         loss = loss + jnp.sum(
@@ -535,7 +553,7 @@ class SymbolPipelineTrainStep:
             local_p = jnp.squeeze(flat_p, 0)
             local_aux = jnp.squeeze(flat_aux, 0)
             state = jnp.zeros((maxB,), jnp.float32)
-            loss_sum = jnp.float32(0.0)
+            loss_sum = jnp.zeros((1,), jnp.float32)
             if hasattr(lax, "pcast"):
                 state = lax.pcast(state, (axis,) + data_axes,
                                   to="varying")
@@ -585,23 +603,44 @@ class SymbolPipelineTrainStep:
         b1 = float(opt_attrs.get("beta1", 0.9))
         b2 = float(opt_attrs.get("beta2", 0.999))
 
+        from .collectives import (all_gather_constraint,
+                                  reduce_scatter_constraint)
+
+        zero = self._zero
+        zero_sh = self._state_sh
+
         def step(flat_p, opt_states, flat_aux, lr, t, data, key):
             if is_adam:
                 lr = lr * jnp.sqrt(1.0 - jnp.power(b2, t)) \
                     / (1.0 - jnp.power(b1, t))
 
             def lossf(p):
-                return sharded_loss(p, flat_aux, data, key)
+                # total comes back rank-1 (see the rank-0 residual note
+                # in pipeline_loss); take the scalar outside shard_map
+                total, aux = sharded_loss(p, flat_aux, data, key)
+                return total[0], aux
 
             (loss, new_aux), g = jax.value_and_grad(
                 lossf, has_aux=True)(flat_p)
+            g = g.astype(flat_p.dtype)
+            p_in = flat_p
+            if zero:
+                # ZeRO-1 on the flat buffers: the grad's pending
+                # data-axis sum reduce-scatters into the owned slice,
+                # the update runs shard-local, the new params
+                # all-gather back to stage rows
+                g = reduce_scatter_constraint(g, zero_sh)
+                p_in = jax.lax.with_sharding_constraint(flat_p, zero_sh)
             res, _ = opt_op.apply(
-                [flat_p, g.astype(flat_p.dtype)] + list(opt_states),
+                [p_in, g] + list(opt_states),
                 dict(opt_attrs, lr=lr), OpContext(is_train=True))
-            return res[0], tuple(res[1:1 + n_states]), new_aux, loss
+            new_p = res[0]
+            if zero:
+                new_p = all_gather_constraint(new_p, self._stack_sh)
+            return new_p, tuple(res[1:1 + n_states]), new_aux, loss
 
         sh = self._stack_sh
-        state_sh = tuple(sh for _ in range(n_states))
+        state_sh = tuple(self._state_sh for _ in range(n_states))
         data_sh = {n: jax.sharding.NamedSharding(self.mesh, data_spec[n])
                    for n in self.input_names}
         return jax.jit(step,
@@ -637,6 +676,14 @@ class SymbolPipelineTrainStep:
     # ------------------------------------------------------------ fence
     def sync(self) -> float:
         return float(np.asarray(self.flat_params[0, 0]))
+
+    # ------------------------------------------------------------ state
+    def optimizer_state_bytes(self):
+        """``(logical_total, per_device)`` bytes of the optimizer state;
+        refreshes the ``optimizer_state_bytes_*`` telemetry gauges."""
+        from .zero import publish_state_gauges
+
+        return publish_state_gauges(list(self.opt_states), "pipeline")
 
     # ----------------------------------------------------------- params
     def get_params(self):

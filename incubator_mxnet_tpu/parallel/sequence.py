@@ -23,6 +23,8 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
+from .mesh import axis_size as _axis_size
+
 __all__ = ["attention", "flash_eligible", "ring_attention",
            "ulysses_attention", "sequence_parallel_attention"]
 
@@ -200,8 +202,11 @@ def _ring_fwd_pass(q, k, v, axis_name, causal, scale, hop_chunk):
     import jax.numpy as jnp
     from jax import lax
 
-    n = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
+    n = _axis_size(axis_name)
+    # the device index feeds only the causal-mask offsets; emitting it
+    # unmasked leaves an orphan PartitionId the SPMD partitioner rejects
+    # (CPU backend), so only materialize it when the mask is on
+    idx = lax.axis_index(axis_name) if causal else jnp.int32(0)
     bq = q.shape[-2]
     bk = k.shape[-2]
     neg = _neg_inf(jnp.float32)
@@ -264,8 +269,9 @@ def _ring_attention_vjp(axis_name, causal, scale, hop_chunk):
 
     def f_bwd(res, do):
         q, k, v, out, lse = res
-        n = lax.axis_size(axis_name)
-        idx = lax.axis_index(axis_name)
+        n = _axis_size(axis_name)
+        # see _ring_fwd_pass: axis_index only when the mask consumes it
+        idx = lax.axis_index(axis_name) if causal else jnp.int32(0)
         bq = q.shape[-2]
         bk = k.shape[-2]
         neg = _neg_inf(jnp.float32)
@@ -344,8 +350,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
     """
     from jax import lax
 
-    n = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
+    n = _axis_size(axis_name)
     if q.shape[1] % n:
         raise ValueError("heads (%d) must be divisible by axis size %d"
                          % (q.shape[1], n))

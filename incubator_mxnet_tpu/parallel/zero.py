@@ -1,0 +1,177 @@
+"""ZeRO-1 optimizer-state sharding plan (Rajbhandari et al., SC'20).
+
+The reference already partitions optimizer state: each PS server owns a
+key range and runs the update for its slice
+(``kvstore_dist_server.h:105-230``).  This module is the TPU-native
+equivalent for the one-program train steps: each parameter's optimizer
+state (adam m/v, momentum, f32 masters) lives sharded over the data-
+parallel mesh axes — composed with whatever model-parallel sharding the
+parameter itself already has (expert weights stay ``P('ep')``-sharded,
+GShard-style, and their state additionally splits over ``dp``).
+
+The execution pattern is the GSPMD spelling of ZeRO-1: gradients are
+forced into the state layout (XLA lowers the dp psum + slice into a
+reduce-scatter), the elementwise update runs on the owned shard only,
+and the updated parameter is forced back to its replicated/param layout
+(an all-gather).  See ``collectives.reduce_scatter_constraint`` /
+``all_gather_constraint`` and ``docs/zero.md``.
+
+Everything here is pure planning — specs and byte math — so it is also
+usable at pod-scale shapes without allocating anything (the dryrun
+proves the E=2048 MoE footprint fits per-device from the plan alone).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from .. import telemetry
+
+__all__ = ["zero_state_spec", "shard_bytes", "state_footprint",
+           "publish_state_gauges"]
+
+
+def _spec_entries(spec, ndim: int):
+    """PartitionSpec → per-dim tuple of axis-name tuples, length ndim."""
+    entries = []
+    for d in range(ndim):
+        e = spec[d] if spec is not None and d < len(spec) else None
+        if e is None:
+            entries.append(())
+        elif isinstance(e, (tuple, list)):
+            entries.append(tuple(e))
+        else:
+            entries.append((e,))
+    return entries
+
+
+def zero_state_spec(mesh_axes: Dict[str, int], param_spec, shape,
+                    shard_axes: Sequence[str] = ("dp",)):
+    """PartitionSpec for one parameter's optimizer state, or None.
+
+    Starts from the parameter's own spec (model-parallel placements are
+    kept — an ``ep``-sharded expert weight's state stays ``ep``-sharded)
+    and greedily adds each axis of ``shard_axes`` present in
+    ``mesh_axes`` with size > 1 onto the first dimension it evenly
+    divides and does not already occupy.  Returns None when nothing new
+    could be sharded (scalar params, no free divisible dim, trivial
+    axes) — the caller keeps the replicated state for that parameter.
+    """
+    import jax
+
+    ndim = len(shape)
+    if ndim == 0:
+        return None
+    entries = _spec_entries(param_spec, ndim)
+    used = {a for e in entries for a in e}
+    # per-dim remaining size after the existing sharding
+    rem = []
+    for d in range(ndim):
+        n = 1
+        for a in entries[d]:
+            n *= mesh_axes.get(a, 1)
+        rem.append(shape[d] // n if n and shape[d] % n == 0 else 0)
+
+    added = False
+    for ax in shard_axes:
+        size = mesh_axes.get(ax, 1)
+        if size <= 1 or ax in used:
+            continue
+        for d in range(ndim):
+            if rem[d] and rem[d] % size == 0:
+                entries[d] = entries[d] + (ax,)
+                rem[d] //= size
+                used.add(ax)
+                added = True
+                break
+    if not added:
+        return None
+    P = jax.sharding.PartitionSpec
+    norm = [None if not e else (e[0] if len(e) == 1 else e)
+            for e in entries]
+    while norm and norm[-1] is None:  # canonical: no trailing Nones
+        norm.pop()
+    return P(*norm)
+
+
+def shard_bytes(mesh_axes: Dict[str, int], spec, shape,
+                itemsize: int = 4) -> int:
+    """Per-device bytes of one array under ``spec`` — pure math (ceil
+    division per dim), valid for arbitrary pod-scale meshes without
+    building them."""
+    n = itemsize
+    entries = _spec_entries(spec, len(shape))
+    for d, s in enumerate(shape):
+        div = 1
+        for a in entries[d]:
+            div *= mesh_axes.get(a, 1)
+        n *= -(-s // div)  # ceil: uneven trailing shards pad
+    return n
+
+
+def state_footprint(mesh_axes: Dict[str, int],
+                    param_shapes: Dict[str, Tuple[int, ...]],
+                    param_specs: Optional[Dict[str, Any]] = None,
+                    n_states: int = 2, itemsize: int = 4,
+                    shard_axes: Sequence[str] = ("dp", "ep")):
+    """Plan the optimizer-state footprint of a parameter set.
+
+    Returns ``(replicated_per_device, sharded_per_device, specs)`` in
+    bytes: what every device holds with replicated state (the seed
+    behavior — each dp replica carries the FULL m/v/master set) vs under
+    the ZeRO-1 plan.  ``n_states`` counts per-param state tensors
+    (adam 2, momentum 1).  Abstract: nothing is allocated, so this runs
+    for the E=2048 flagship on a laptop.
+    """
+    param_specs = param_specs or {}
+    replicated = 0
+    sharded = 0
+    specs = {}
+    for name, shape in param_shapes.items():
+        base = param_specs.get(name)
+        zspec = zero_state_spec(mesh_axes, base, shape,
+                                shard_axes=shard_axes)
+        specs[name] = zspec if zspec is not None else base
+        per_state_rep = shard_bytes(mesh_axes, base, shape, itemsize)
+        per_state_shard = shard_bytes(mesh_axes, specs[name], shape,
+                                      itemsize)
+        replicated += n_states * per_state_rep
+        sharded += n_states * per_state_shard
+    return replicated, sharded, specs
+
+
+def publish_state_gauges(states, scope: str) -> Tuple[int, int]:
+    """Set the telemetry gauges for a live set of optimizer-state arrays.
+
+    ``states`` is any pytree of jax arrays.  Publishes
+    ``optimizer_state_bytes_total`` (logical, all shards summed once —
+    what ONE full copy of the state weighs) and
+    ``optimizer_state_bytes_per_device`` (what each device actually
+    holds), labeled by ``scope``.  Returns ``(total, per_device)``.
+    """
+    import jax
+    import numpy as np
+
+    total = 0
+    per_device = 0
+    for leaf in jax.tree_util.tree_leaves(states):
+        if not hasattr(leaf, "shape"):
+            continue
+        itemsize = np.dtype(leaf.dtype).itemsize
+        n = 1
+        for s in leaf.shape:
+            n *= int(s)
+        total += n * itemsize
+        try:
+            shard_shape = leaf.sharding.shard_shape(leaf.shape)
+        except Exception:
+            shard_shape = leaf.shape
+        m = 1
+        for s in shard_shape:
+            m *= int(s)
+        per_device += m * itemsize
+    if telemetry.enabled():
+        lab = {"scope": scope}
+        telemetry.gauge("optimizer_state_bytes_total", lab).set(total)
+        telemetry.gauge("optimizer_state_bytes_per_device", lab).set(
+            per_device)
+    return total, per_device

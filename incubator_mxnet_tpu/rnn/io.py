@@ -1,10 +1,17 @@
 """RNN data io — ``encode_sentences`` + ``BucketSentenceIter``.
 
-Reference analog: ``python/mxnet/rnn/io.py:30,78``.
+Reference analog: ``python/mxnet/rnn/io.py:30,78`` — same public surface
+(``encode_sentences`` builds or extends a vocab while integer-coding
+token lists; ``BucketSentenceIter`` pads variable-length sentences into
+the smallest fitting bucket and yields language-model batches whose
+``bucket_key`` is the padded length), reimplemented here: buckets are
+padded as whole 2-D arrays rather than sentence-by-sentence, and the
+next-token label shift happens once per bucket at ``reset``.
 """
 from __future__ import annotations
 
 import bisect
+import logging
 import random
 
 import numpy as np
@@ -14,123 +21,140 @@ from ..io import DataBatch, DataDesc, DataIter
 
 __all__ = ["encode_sentences", "BucketSentenceIter"]
 
+logger = logging.getLogger(__name__)
+
 
 def encode_sentences(sentences, vocab=None, invalid_label=-1,
                      invalid_key="\n", start_label=0):
-    """Encode tokenized sentences to int arrays, building/extending the
-    vocab (reference ``rnn/io.py:30``)."""
-    idx = start_label
+    """Map tokenized sentences to lists of int ids.
+
+    With ``vocab=None`` a fresh vocab is grown from the corpus (ids from
+    ``start_label``, skipping ``invalid_label`` which is reserved for
+    ``invalid_key`` / padding); a supplied vocab is read-only and an
+    unknown token is an error.  Returns ``(coded_sentences, vocab)``
+    (reference ``rnn/io.py:30``).
+    """
     if vocab is None:
         vocab = {invalid_key: invalid_label}
-        new_vocab = True
+        frozen = False
     else:
-        new_vocab = False
-    res = []
-    for sent in sentences:
-        coded = []
-        for word in sent:
-            if word not in vocab:
-                assert new_vocab, "Unknown token %s" % word
-                if idx == invalid_label:
-                    idx += 1
-                vocab[word] = idx
-                idx += 1
-            coded.append(vocab[word])
-        res.append(coded)
-    return res, vocab
+        frozen = True
+    next_id = start_label
+
+    def lookup(word):
+        nonlocal next_id
+        if word not in vocab:
+            if frozen:
+                raise ValueError("unknown token %r not in supplied vocab"
+                                 % (word,))
+            if next_id == invalid_label:  # reserved for padding
+                next_id += 1
+            vocab[word] = next_id
+            next_id += 1
+        return vocab[word]
+
+    coded = [[lookup(w) for w in sent] for sent in sentences]
+    return coded, vocab
 
 
 class BucketSentenceIter(DataIter):
-    """Buckets variable-length sentences, pads within bucket, yields
-    batches whose ``bucket_key`` is the padded length
-    (reference ``rnn/io.py:78``)."""
+    """Bucketed language-model iterator over integer-coded sentences.
+
+    Each sentence lands in the smallest bucket that fits it, right-padded
+    with ``invalid_label``; sentences longer than every bucket are
+    dropped (logged).  Batches are whole slices of one bucket — data is
+    the padded sentence, label the next-token shift — and carry
+    ``bucket_key`` = that bucket's length for ``BucketingModule``.
+    ``layout`` selects batch-major ``"NTC"`` (B, T) or time-major
+    ``"TNC"`` (T, B) tensors (reference ``rnn/io.py:78``).
+    """
 
     def __init__(self, sentences, batch_size, buckets=None,
                  invalid_label=-1, data_name="data",
                  label_name="softmax_label", dtype="float32",
                  layout="NTC"):
         super().__init__(batch_size)
-        if not buckets:
-            buckets = [i for i, j in enumerate(
-                np.bincount([len(s) for s in sentences]))
-                if j >= batch_size]
-        buckets.sort()
+        self.major_axis = DataDesc.get_batch_axis(layout)
+        if self.major_axis not in (0, 1):
+            raise ValueError("Invalid layout %s: must be NT (batch "
+                             "major) or TN (time major)" % layout)
 
+        lengths = [len(s) for s in sentences]
+        if not buckets:
+            # auto-buckets: every length that can fill at least one batch
+            buckets = [length for length, n
+                       in enumerate(np.bincount(lengths))
+                       if n >= batch_size]
+        buckets = sorted(buckets)
+
+        # one padded (rows, bucket_len) array per bucket
+        grouped = [[] for _ in buckets]
         ndiscard = 0
-        self.data = [[] for _ in buckets]
-        for sent in sentences:
-            buck = bisect.bisect_left(buckets, len(sent))
-            if buck == len(buckets):
+        for sent, n in zip(sentences, lengths):
+            b = bisect.bisect_left(buckets, n)
+            if b == len(buckets):
                 ndiscard += 1
                 continue
-            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
-            buff[:len(sent)] = sent
-            self.data[buck].append(buff)
-        # drop buckets no sentence landed in (a 0-row bucket breaks the
-        # 2-D label shift in reset and can never yield a batch)
-        kept = [(b, np.asarray(d, dtype=dtype))
-                for b, d in zip(buckets, self.data) if len(d) > 0]
-        buckets = [b for b, _ in kept]
-        self.data = [d for _, d in kept]
+            grouped[b].append(sent)
         if ndiscard:
-            print("WARNING: discarded %d sentences longer than the "
-                  "largest bucket." % ndiscard)
+            logger.warning("discarded %d sentences longer than the "
+                           "largest bucket.", ndiscard)
+        # empty buckets can never yield a batch and would break the
+        # 2-D label shift in reset — drop them outright
+        self.buckets = [b for b, g in zip(buckets, grouped) if g]
+        self.data = [self._pad(g, b, invalid_label, dtype)
+                     for b, g in zip(buckets, grouped) if g]
 
-        self.batch_size = batch_size
-        self.buckets = buckets
         self.data_name = data_name
         self.label_name = label_name
         self.dtype = dtype
         self.invalid_label = invalid_label
+        self.layout = layout
         self.nddata = []
         self.ndlabel = []
-        self.major_axis = layout.find("N")
-        self.layout = layout
-        self.default_bucket_key = max(buckets)
+        self.default_bucket_key = max(self.buckets)
+        self.provide_data = [DataDesc(
+            name=data_name,
+            shape=self._batch_shape(self.default_bucket_key),
+            layout=layout)]
+        self.provide_label = [DataDesc(
+            name=label_name,
+            shape=self._batch_shape(self.default_bucket_key),
+            layout=layout)]
 
-        if self.major_axis == 0:
-            self.provide_data = [DataDesc(
-                name=self.data_name,
-                shape=(batch_size, self.default_bucket_key),
-                layout=layout)]
-            self.provide_label = [DataDesc(
-                name=self.label_name,
-                shape=(batch_size, self.default_bucket_key),
-                layout=layout)]
-        elif self.major_axis == 1:
-            self.provide_data = [DataDesc(
-                name=self.data_name,
-                shape=(self.default_bucket_key, batch_size),
-                layout=layout)]
-            self.provide_label = [DataDesc(
-                name=self.label_name,
-                shape=(self.default_bucket_key, batch_size),
-                layout=layout)]
-        else:
-            raise ValueError("Invalid layout %s: Must by NT (batch "
-                             "major) or TN (time major)" % layout)
-
-        self.idx = []
-        for i, buck in enumerate(self.data):
-            self.idx.extend([(i, j) for j in
-                             range(0, len(buck) - batch_size + 1,
-                                   batch_size)])
+        # (bucket, row-offset) pairs, one per full batch; partial
+        # remainders never ship
+        self.idx = [(i, j)
+                    for i, rows in enumerate(self.data)
+                    for j in range(0, len(rows) - batch_size + 1,
+                                   batch_size)]
         self.curr_idx = 0
         self.reset()
+
+    @staticmethod
+    def _pad(sents, bucket_len, invalid_label, dtype):
+        out = np.full((len(sents), bucket_len), invalid_label,
+                      dtype=dtype)
+        for row, sent in zip(out, sents):
+            row[:len(sent)] = sent
+        return out
+
+    def _batch_shape(self, bucket_key):
+        if self.major_axis == 0:  # batch major
+            return (self.batch_size, bucket_key)
+        return (bucket_key, self.batch_size)  # time major
 
     def reset(self):
         self.curr_idx = 0
         random.shuffle(self.idx)
-        for buck in self.data:
-            np.random.shuffle(buck)
-
         self.nddata = []
         self.ndlabel = []
-        for buck in self.data:
-            label = np.empty_like(buck)
-            label[:, :-1] = buck[:, 1:]
-            label[:, -1] = self.invalid_label
-            self.nddata.append(ndarray.array(buck, dtype=self.dtype))
+        for rows in self.data:
+            np.random.shuffle(rows)
+            # language-model target: the next token, padded at the end
+            label = np.full_like(rows, self.invalid_label)
+            label[:, :-1] = rows[:, 1:]
+            self.nddata.append(ndarray.array(rows, dtype=self.dtype))
             self.ndlabel.append(ndarray.array(label, dtype=self.dtype))
 
     def next(self):
@@ -139,12 +163,10 @@ class BucketSentenceIter(DataIter):
         i, j = self.idx[self.curr_idx]
         self.curr_idx += 1
 
-        if self.major_axis == 1:
-            data = self.nddata[i][j:j + self.batch_size].T
-            label = self.ndlabel[i][j:j + self.batch_size].T
-        else:
-            data = self.nddata[i][j:j + self.batch_size]
-            label = self.ndlabel[i][j:j + self.batch_size]
+        data = self.nddata[i][j:j + self.batch_size]
+        label = self.ndlabel[i][j:j + self.batch_size]
+        if self.major_axis == 1:  # time major: (B, T) -> (T, B)
+            data, label = data.T, label.T
 
         return DataBatch(
             [data], [label], pad=0, bucket_key=self.buckets[i],

@@ -23,8 +23,11 @@ Instrumented layers: ``lowering`` (compile counts/wall-time, lowering
 cache), ``executor``/``module`` (step latency, samples/sec, epochs),
 ``engine`` (dispatch counts, fences, in-flight depth), ``ps``/``kvstore``
 (RPC count/bytes/latency per verb, retries, heartbeats, dead nodes),
-``parallel.collectives`` (invocations by kind + payload bytes), and
-device memory via ``jax.local_devices()[*].memory_stats()``.
+``parallel.collectives`` (invocations by kind + payload bytes),
+``parallel.zero`` (``optimizer_state_bytes_total`` /
+``optimizer_state_bytes_per_device`` gauges labeled by train-step
+scope — the ZeRO-1 footprint signal), and device memory via
+``jax.local_devices()[*].memory_stats()``.
 
 Env controls::
 

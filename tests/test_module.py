@@ -136,15 +136,22 @@ def test_metrics():
     m.update([label], [pred])
     assert abs(m.get()[1] - 2.0 / 3) < 1e-6
 
-    # framewise labels: (B, T) labels vs (B*T, C) class scores — the
-    # reference argmaxes on ANY shape mismatch (metric.py:391) and
-    # counts flat (time-distributed softmax, speech/bi-lstm drivers)
-    frame_pred = mx.nd.array([[0.9, 0.1], [0.2, 0.8],
-                              [0.6, 0.4], [0.3, 0.7]])
+    # framewise labels: (B, T, C) class scores argmax over the class
+    # axis against (B, T) labels — the reference argmaxes only when
+    # the prediction carries an EXTRA axis (metric.py:391 ndim rule)
+    frame_pred = mx.nd.array([[[0.9, 0.1], [0.2, 0.8]],
+                              [[0.6, 0.4], [0.3, 0.7]]])  # (2, 2, C=2)
     frame_label = mx.nd.array([[0, 1], [1, 1]])       # (B=2, T=2)
-    fm = mx.metric.Accuracy()
+    fm = mx.metric.Accuracy(axis=-1)
     fm.update([frame_label], [frame_pred])
     assert abs(fm.get()[1] - 3.0 / 4) < 1e-6
+
+    # equal-rank shape mismatches are no longer silently argmaxed into
+    # nonsense counts — they raise
+    with pytest.raises(mx.base.MXNetError):
+        mx.metric.Accuracy().update(
+            [frame_label], [mx.nd.array([[0.9, 0.1], [0.2, 0.8],
+                                         [0.6, 0.4], [0.3, 0.7]])])
 
     ce = mx.metric.create("ce")
     ce.update([label], [pred])

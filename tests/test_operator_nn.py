@@ -325,6 +325,36 @@ def test_softmax_ops():
     check_grad_fd("softmax", [x[:2, :3]])
 
 
+def test_softmax_cross_entropy():
+    """(1,)-shaped total batch loss with softmax-minus-onehot gradient
+    (loss_binary_op.cc:29)."""
+    rng = np.random.RandomState(9)
+    data = rng.randn(6, 5).astype(np.float32)
+    label = rng.randint(0, 5, 6).astype(np.float32)
+    p = _np_softmax(data.astype(np.float64))
+    want = -np.log(p[np.arange(6), label.astype(int)]).sum()
+    outs = check_fwd("softmax_cross_entropy", [data, label],
+                     np.array([want]), rtol=1e-5, atol=1e-5)
+    assert outs[0].shape == (1,)
+    check_fwd("SoftmaxCrossEntropy", [data, label], np.array([want]),
+              rtol=1e-5, atol=1e-5)
+    # analytic gradient: d(sum xent)/d(data) = p - onehot
+    op = get_op("softmax_cross_entropy")
+    g = jax.grad(lambda d: op.apply(
+        [d, jnp.asarray(label)], {}, OpContext())[0][0].sum()
+    )(jnp.asarray(data))
+    oh = np.eye(5)[label.astype(int)]
+    np.testing.assert_allclose(np.asarray(g), p - oh,
+                               rtol=1e-4, atol=1e-4)
+    check_grad_fd("softmax_cross_entropy", [data[:3], label[:3]])
+    # mx.nd surface (the user-visible entry, VERDICT.md gap #1)
+    import incubator_mxnet_tpu as mx
+
+    nd_out = mx.nd.softmax_cross_entropy(mx.nd.array(data),
+                                         mx.nd.array(label))
+    np.testing.assert_allclose(nd_out.asnumpy(), [want], rtol=1e-5)
+
+
 def test_softmax_output_grad():
     """Backward ignores the cotangent and emits (p - onehot)·grad_scale
     (softmax_output-inl.h)."""
@@ -836,6 +866,7 @@ NN_COVERED = {
     "FullyConnected", "Convolution", "Convolution_v1", "Deconvolution",
     "Activation", "LeakyReLU", "softmax", "log_softmax",
     "SoftmaxActivation", "SoftmaxOutput", "Softmax",
+    "softmax_cross_entropy", "SoftmaxCrossEntropy",
     "LinearRegressionOutput", "MAERegressionOutput",
     "LogisticRegressionOutput", "SVMOutput", "BatchNorm", "BatchNorm_v1",
     "InstanceNorm", "LayerNorm", "LRN", "Pooling", "Pooling_v1",

@@ -27,7 +27,9 @@ def test_build_mesh():
 def test_collectives_shard_map():
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from incubator_mxnet_tpu.parallel.mesh import shard_map_fn
+
+    shard_map = shard_map_fn()
 
     mesh = parallel.build_mesh({"dp": 8})
     P = jax.sharding.PartitionSpec
@@ -44,7 +46,9 @@ def test_collectives_shard_map():
 def test_ring_permute():
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from incubator_mxnet_tpu.parallel.mesh import shard_map_fn
+
+    shard_map = shard_map_fn()
 
     mesh = parallel.build_mesh({"dp": 8})
     P = jax.sharding.PartitionSpec

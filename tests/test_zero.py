@@ -1,0 +1,247 @@
+"""ZeRO-1 optimizer-state sharding (``parallel/zero.py``, docs/zero.md).
+
+The contract under test, on the 8-virtual-device CPU mesh:
+
+- sharding the optimizer state changes WHERE the update runs, never
+  WHAT it computes — sharded and replicated training match numerically
+  for both ``FusedTrainStep`` and ``SymbolPipelineTrainStep``;
+- per-device state bytes drop to ~1/dp (and 1/ep for expert params),
+  visible through ``optimizer_state_bytes_*`` telemetry gauges;
+- checkpoints reshard on restore: replicated state loads onto a
+  sharded step and vice versa (``parallel/checkpoint.py``).
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import parallel, telemetry
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.parallel import (FusedTrainStep,
+                                          SymbolPipelineTrainStep)
+from incubator_mxnet_tpu.parallel.zero import (shard_bytes,
+                                               state_footprint,
+                                               zero_state_spec)
+
+OPTS = [("sgd", {"learning_rate": 0.2, "momentum": 0.9}),
+        ("adam", {"learning_rate": 0.01})]
+
+
+def _mlp(layers=3, hidden=16, classes=5, indim=12):
+    x = mx.sym.Variable("data")
+    for i in range(layers):
+        x = mx.sym.FullyConnected(x, num_hidden=hidden, name="fc%d" % i)
+        x = mx.sym.Activation(x, act_type="relu", name="relu%d" % i)
+    x = mx.sym.FullyConnected(x, num_hidden=classes, name="out")
+    return mx.sym.SoftmaxOutput(x, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def _batches(n=3, batch=16, indim=12, classes=5, seed=3):
+    rng = np.random.RandomState(seed)
+    return [{"data": rng.randn(batch, indim).astype(np.float32),
+             "softmax_label": rng.randint(0, classes, batch)
+             .astype(np.float32)} for _ in range(n)]
+
+
+def _fused(opt, oparams, zero, mesh_axes=None):
+    mx.random.seed(11)
+    mesh = parallel.build_mesh(dict(mesh_axes or {"dp": 8}))
+    return FusedTrainStep(
+        _mlp(), {"data": (16, 12)}, {"softmax_label": (16,)},
+        mesh=mesh, optimizer=opt, optimizer_params=dict(oparams),
+        initializer=mx.initializer.Xavier(), shard_optimizer=zero)
+
+
+# ---------------------------------------------------------------------------
+# equivalence: sharded == replicated, both train steps, both optimizers
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("opt,oparams", OPTS, ids=[o[0] for o in OPTS])
+def test_fused_sharded_matches_replicated(opt, oparams):
+    params = {}
+    for zero in (False, True):
+        step = _fused(opt, oparams, zero)
+        for b in _batches():
+            step(b)
+        params[zero] = {k: np.asarray(v) for k, v in step.params.items()}
+    assert sorted(params[False]) == sorted(params[True])
+    for k in params[False]:
+        np.testing.assert_allclose(params[True][k], params[False][k],
+                                   rtol=1e-6, atol=1e-7, err_msg=k)
+
+
+@pytest.mark.parametrize("opt,oparams", OPTS, ids=[o[0] for o in OPTS])
+def test_pipeline_sharded_matches_replicated(opt, oparams):
+    flat = {}
+    for zero in (False, True):
+        mx.random.seed(11)
+        mesh = parallel.build_mesh({"pp": 2, "dp": 4})
+        step = SymbolPipelineTrainStep(
+            _mlp(), {"data": (16, 12)}, {"softmax_label": (16,)},
+            mesh=mesh, num_microbatches=2, optimizer=opt,
+            optimizer_params=dict(oparams),
+            initializer=mx.initializer.Xavier(), shard_optimizer=zero)
+        for b in _batches():
+            step(b)
+        flat[zero] = np.asarray(step.flat_params)
+    # ZeRO pads the flat stage buffers up to a multiple of the
+    # data-shard count; the real parameters live in the prefix
+    w = flat[False].shape[1]
+    np.testing.assert_allclose(flat[True][:, :w], flat[False],
+                               rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# footprint: per-device bytes ~1/dp, gauges published
+# ---------------------------------------------------------------------------
+
+
+def test_fused_state_bytes_match_plan():
+    step = _fused("adam", {"learning_rate": 0.01}, True)
+    total, per_dev = step.optimizer_state_bytes()
+    # recompute the expectation from the pure planning module
+    mesh_axes = {"dp": 8}
+    exp_total = exp_dev = 0
+    for name, p in step.params.items():
+        shape = tuple(p.shape)
+        spec = zero_state_spec(mesh_axes, None, shape,
+                               shard_axes=("dp", "ep"))
+        exp_total += 2 * shard_bytes({}, None, shape)
+        exp_dev += 2 * shard_bytes(mesh_axes, spec, shape)
+    assert total == exp_total
+    assert per_dev == exp_dev
+    # the divisible tensors dominate, so the fraction lands near 1/8
+    assert per_dev * 4 < total
+
+
+def test_replicated_state_bytes_are_full():
+    step = _fused("adam", {"learning_rate": 0.01}, False)
+    total, per_dev = step.optimizer_state_bytes()
+    assert per_dev == total
+
+
+def test_gauges_published(tmp_path):
+    telemetry.disable()
+    reg = telemetry.enable(str(tmp_path / "t.jsonl"))
+    try:
+        _fused("adam", {"learning_rate": 0.01}, True)
+        snap = reg.snapshot()["metrics"]
+        keys = [k for k in snap
+                if "optimizer_state_bytes_per_device" in k
+                and "fused" in k]
+        assert keys, sorted(snap)
+        totals = [k for k in snap
+                  if "optimizer_state_bytes_total" in k and "fused" in k]
+        assert snap[keys[0]]["value"] * 4 < snap[totals[0]]["value"]
+    finally:
+        telemetry.disable()
+
+
+def test_expert_state_shards_over_ep_and_dp():
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    mesh_axes = {"dp": 2, "ep": 4}
+    # expert weight (E, d_in, d_out) already P('ep'): state keeps ep
+    # and additionally splits a free divisible dim over dp
+    spec = zero_state_spec(mesh_axes, P("ep"), (4, 16, 32),
+                           shard_axes=("dp", "ep"))
+    assert spec == P("ep", "dp")
+    full = shard_bytes({}, None, (4, 16, 32))
+    dev = shard_bytes(mesh_axes, spec, (4, 16, 32))
+    assert dev == full // 8
+
+
+def test_zero_state_spec_edge_cases():
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    # scalars and non-divisible shapes stay replicated (None)
+    assert zero_state_spec({"dp": 8}, None, ()) is None
+    assert zero_state_spec({"dp": 8}, None, (7, 3)) is None
+    # trivial axes add nothing
+    assert zero_state_spec({"dp": 1}, None, (16,)) is None
+    # plain data-parallel case: first divisible dim takes dp
+    assert zero_state_spec({"dp": 8}, None, (16, 12)) == P("dp")
+    # dim already claimed by the param's own sharding is skipped
+    assert zero_state_spec({"dp": 2, "tp": 2}, P("tp", None), (8, 6),
+                           shard_axes=("dp",)) == P(("tp", "dp"))
+
+
+def test_state_footprint_flagship_math():
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    # E=2048 flagship expert tensors (PERF.md §8: 4 experts x 8 layers
+    # is ~1.1B expert params): state must land at exactly 1/(dp*ep)
+    shapes = {"moe%d_moe_w1" % i: (4, 2048, 8192) for i in range(8)}
+    shapes.update({"moe%d_moe_w2" % i: (4, 8192, 2048)
+                   for i in range(8)})
+    specs = {n: P("ep") for n in shapes}
+    pod = {"dp": 2, "ep": 4}
+    rep, shard, out_specs = state_footprint(pod, shapes, specs,
+                                            n_states=2)
+    full, _, _ = state_footprint({"dp": 1, "ep": 1}, shapes, {},
+                                 n_states=2)
+    assert rep == full // 4        # param's own ep sharding
+    assert shard == full // 8      # ZeRO adds the dp split
+    assert all(s == P("ep", "dp") for s in out_specs.values())
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: restore reshards in both directions
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("save_zero", [False, True],
+                         ids=["replicated_to_sharded",
+                              "sharded_to_replicated"])
+def test_checkpoint_reshards_on_restore(tmp_path, save_zero):
+    from incubator_mxnet_tpu.parallel.checkpoint import (restore_sharded,
+                                                         save_sharded)
+
+    batches = _batches(4)
+    opt, oparams = "adam", {"learning_rate": 0.01}
+    # uninterrupted replicated run = ground truth
+    ref = _fused(opt, oparams, False)
+    for b in batches:
+        ref(b)
+    # train 2 steps in one layout, checkpoint, resume the remaining 2
+    # in the OTHER layout
+    src = _fused(opt, oparams, save_zero)
+    for b in batches[:2]:
+        src(b)
+    save_sharded(str(tmp_path / "ckpt"), src)
+    dst = _fused(opt, oparams, not save_zero)
+    restore_sharded(str(tmp_path / "ckpt"), dst)
+    for b in batches[2:]:
+        dst(b)
+    for k, v in ref.params.items():
+        np.testing.assert_allclose(np.asarray(dst.params[k]),
+                                   np.asarray(v), rtol=1e-6, atol=1e-7,
+                                   err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+
+def test_shard_optimizer_conflicts_with_flat_optimizer():
+    mx.random.seed(11)
+    mesh = parallel.build_mesh({"dp": 8})
+    with pytest.raises(MXNetError):
+        FusedTrainStep(_mlp(), {"data": (16, 12)},
+                       {"softmax_label": (16,)}, mesh=mesh,
+                       optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01},
+                       initializer=mx.initializer.Xavier(),
+                       flat_optimizer=True, shard_optimizer=True)
+
+
+def test_env_knob_enables_sharding(monkeypatch):
+    monkeypatch.setenv("TP_SHARD_OPTIMIZER", "1")
+    step = _fused("adam", {"learning_rate": 0.01}, None)
+    total, per_dev = step.optimizer_state_bytes()
+    assert per_dev * 4 < total

@@ -14,6 +14,8 @@ TP_LM_DTYPE (bfloat16), TP_LM_HEAD (fused|softmax),
 TP_LM_OPT_DTYPE / TP_LM_GRAD_DTYPE (bf16 opt-ins, PERF.md §21b),
 TP_LM_MOE (experts per layer, 0 = dense) / TP_LM_MOE_TOPK (2) /
 TP_LM_MOE_CAP (1.25) — the MoE model family (PERF.md §8e),
+TP_LM_DP (1: data-parallel mesh size) and TP_LM_SHARD_OPT=1
+(ZeRO-1 optimizer-state sharding over that dp axis, docs/zero.md),
 TP_LM_SMALL=1 (CPU smoke), TP_SUSTAINED_TFLOPS (154, PERF.md §10),
 TP_PEAK_TFLOPS (197, v5e bf16 nominal).
 """
@@ -105,6 +107,8 @@ def run(defaults=None):
     # FLOPs count can never exceed the executed work
     moe_k = min(int(cfg("TP_LM_MOE_TOPK", 2)), moe) if moe else 2
     moe_cap = float(cfg("TP_LM_MOE_CAP", 1.25))
+    ndp = int(cfg("TP_LM_DP", 1))
+    shard_opt = cfg("TP_LM_SHARD_OPT", "0") == "1"
     net = mx.models.transformer_lm(
         vocab_size=V, embed=E, heads=heads,
         num_layers=L, seq_len=S, batch_size=B, dtype=dtype, head=head,
@@ -112,11 +116,13 @@ def run(defaults=None):
         moe_capacity=moe_cap)
     step = parallel.FusedTrainStep(
         net, {"data": (B, S)}, {"softmax_label": (B, S)},
-        mesh=parallel.default_mesh(1), optimizer="adam",
+        mesh=parallel.default_mesh(ndp), optimizer="adam",
         optimizer_params={"learning_rate": 1e-3},
         opt_state_dtype=cfg("TP_LM_OPT_DTYPE", "") or None,
         grad_dtype=cfg("TP_LM_GRAD_DTYPE", "") or None,
-        initializer=mx.initializer.Xavier())
+        initializer=mx.initializer.Xavier(),
+        shard_optimizer=shard_opt)
+    _, opt_bytes_dev = step.optimizer_state_bytes()
 
     rng = np.random.RandomState(0)
     bd = {"data": jax.device_put(
@@ -159,6 +165,8 @@ def run(defaults=None):
         # states what ACTUALLY ran (a "tuned" label alone could lie)
         "opt_state_dtype": cfg("TP_LM_OPT_DTYPE", "") or "float32",
         "grad_dtype": cfg("TP_LM_GRAD_DTYPE", "") or "float32",
+        "mesh_dp": ndp, "shard_optimizer": shard_opt,
+        "opt_state_bytes_per_device": int(opt_bytes_dev),
         "model_tflops_per_sec": round(tflops, 1),
         "mfu_vs_sustained": round(tflops / sustained, 3),
         "mfu_vs_peak": round(tflops / peak, 3)}

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Minimal lint + compile gate — stdlib only, no third-party linters.
+
+Run from the repo root (CI entry point):
+
+    python tools/check.py
+
+Checks, in order:
+
+1. **compile** — every ``.py`` under the package, tests, examples and
+   tools byte-compiles (catches syntax errors without importing jax);
+2. **lint** — cheap ast/text rules the codebase holds itself to:
+   no tab indentation, no bare ``except:``, no ``print(`` inside the
+   library package (use ``logging``; scripts/examples/tools are exempt),
+   lines ≤ 100 chars in the package;
+3. **docs** — every relative ``.md`` link in ``docs/`` and README
+   resolves to a file.
+
+Exit code 0 = clean; 1 = findings (printed one per line).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import py_compile
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "incubator_mxnet_tpu"
+PY_DIRS = [PKG, "tests", "examples", "tools"]
+PY_FILES_TOP = ["bench.py", "__graft_entry__.py"]
+MAX_LINE = 100
+# stdout IS the contract here (mx.viz.print_summary prints a table)
+PRINT_OK = {os.path.join(PKG, "visualization.py")}
+
+
+def _py_files():
+    for d in PY_DIRS:
+        for dirpath, dirnames, filenames in os.walk(os.path.join(ROOT, d)):
+            dirnames[:] = [n for n in dirnames if n != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+    for name in PY_FILES_TOP:
+        path = os.path.join(ROOT, name)
+        if os.path.exists(path):
+            yield path
+
+
+def check_compile(problems):
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        for i, path in enumerate(_py_files()):
+            try:
+                py_compile.compile(path,
+                                   cfile=os.path.join(tmp, "%d.pyc" % i),
+                                   doraise=True)
+            except py_compile.PyCompileError as e:
+                problems.append("compile: %s" % e.msg.strip())
+
+
+def check_lint(problems):
+    for path in _py_files():
+        rel = os.path.relpath(path, ROOT)
+        in_pkg = rel.startswith(PKG + os.sep)
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for i, line in enumerate(src.splitlines(), 1):
+            if line.startswith("\t"):
+                problems.append("lint: %s:%d tab indentation" % (rel, i))
+            if in_pkg and len(line) > MAX_LINE:
+                problems.append("lint: %s:%d line >%d chars"
+                                % (rel, i, MAX_LINE))
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError:
+            continue  # the compile pass already reported it
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                problems.append("lint: %s:%d bare 'except:'"
+                                % (rel, node.lineno))
+            if (in_pkg and rel not in PRINT_OK
+                    and isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                problems.append("lint: %s:%d print() in library code — "
+                                "use logging" % (rel, node.lineno))
+
+
+_LINK = re.compile(r"\]\(([^)#]+\.md)(#[^)]*)?\)")
+
+
+def check_docs(problems):
+    md = [os.path.join(ROOT, "README.md")]
+    docs = os.path.join(ROOT, "docs")
+    md += [os.path.join(docs, n) for n in sorted(os.listdir(docs))
+           if n.endswith(".md")]
+    for path in md:
+        rel = os.path.relpath(path, ROOT)
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        for m in _LINK.finditer(text):
+            target = m.group(1)
+            if target.startswith(("http://", "https://")):
+                continue
+            if not os.path.exists(
+                    os.path.join(os.path.dirname(path), target)):
+                problems.append("docs: %s links missing file %s"
+                                % (rel, target))
+
+
+def main():
+    problems = []
+    check_compile(problems)
+    check_lint(problems)
+    check_docs(problems)
+    for p in problems:
+        print(p)
+    print("%d file(s) checked, %d problem(s)"
+          % (sum(1 for _ in _py_files()), len(problems)))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
