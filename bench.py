@@ -131,6 +131,63 @@ def _resnet_record(small):
     return record
 
 
+def _pipeline_record(small):
+    """Pipeline-schedule sub-record (docs/pipeline.md): the generic
+    symbol pipeline timed on the 1F1B schedule (default; override with
+    TP_PP_SCHEDULE), with the GPipe peak-memory contrast from the AOT
+    compiled ``memory_analysis`` riding along — the schedules are
+    bit-equal, so only the memory/throughput numbers differ."""
+    import jax
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+
+    L = max(d for d in (4, 2, 1) if d <= jax.device_count())
+    M = int(os.environ.get("TP_BENCH_PP_MICRO", str(4 * L)))
+    steps = int(os.environ.get("TP_BENCH_STEPS", "3" if small else "10"))
+    V, E, S, b = (16, 32, 16, 2) if small else (2048, 512, 256, 4)
+    B = b * M
+    schedule = os.environ.get("TP_PP_SCHEDULE", "1f1b")
+    net = mx.models.transformer_lm(
+        vocab_size=V, embed=E, heads=2, num_layers=max(L, 2),
+        seq_len=S, batch_size=b, dtype="float32", head="fused")
+    mesh = parallel.build_mesh({"pp": L})
+    peaks = {}
+    bench_step = None
+    for sched in ("gpipe", "1f1b"):
+        mx.random.seed(0)
+        step = parallel.SymbolPipelineTrainStep(
+            net, {"data": (B, S)}, {"softmax_label": (B, S)},
+            mesh=mesh, num_microbatches=M, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-3},
+            initializer=mx.initializer.Xavier(), schedule=sched)
+        peaks[sched] = step.peak_stage_bytes()
+        if sched == schedule or bench_step is None:
+            bench_step = step
+
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, V, (B, S)).astype(np.float32)
+    bd = {"data": toks, "softmax_label": (toks + 1) % V}
+    bench_step(bd)
+    bench_step.sync()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        bench_step(bd)
+    bench_step.sync()  # readback fence on the updated parameters
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "pipeline_lm_train_tokens_per_sec",
+        "value": round(B * S * steps / dt, 1),
+        "unit": "tokens/s",
+        "schedule": bench_step.schedule, "pp": L,
+        "num_microbatches": M, "batch": B, "seq_len": S, "embed": E,
+        "bubble_fraction": round(bench_step.bubble_fraction, 4),
+        "peak_stage_bytes": peaks[bench_step.schedule],
+        "peak_stage_bytes_gpipe": peaks["gpipe"],
+        "peak_stage_bytes_1f1b": peaks["1f1b"],
+    }
+
+
 def main():
     small = os.environ.get("TP_BENCH_SMALL") == "1"
     # telemetry snapshot rides along with the BENCH record (JSONL next to
@@ -188,6 +245,25 @@ def main():
                              "opt_state_bytes_per_device")}
     combined["opt_state_bytes_per_device"] = \
         lm["opt_state_bytes_per_device"]
+    # MoE row (PERF.md §8e): same flagship step with the expert FFN —
+    # driver-captured so the MoE throughput claim has provenance too
+    moe = bench_lm.run(defaults=dict(
+        lm_defaults, TP_LM_MOE=2 if small else 8))
+    combined["moe"] = {
+        k: moe[k] for k in ("value", "model_tflops_per_sec",
+                            "mfu_vs_sustained", "moe_experts",
+                            "moe_top_k", "moe_capacity")}
+    # S=16k long-context row: exercises the flash causal-attention
+    # block-skipping path where the quadratic term dominates
+    lc = bench_lm.run(defaults=dict(
+        lm_defaults, TP_LM_SEQ=64 if small else 16384,
+        TP_LM_BATCH=1))
+    combined["long_context"] = {
+        k: lc[k] for k in ("value", "model_tflops_per_sec",
+                           "mfu_vs_sustained", "batch", "seq_len")}
+    # 1F1B pipeline schedule sub-record (docs/pipeline.md): schedule,
+    # bubble fraction and the GPipe-vs-1F1B compiled peak-memory A/B
+    combined["pipeline"] = _pipeline_record(small)
     # vs_baseline keeps the ResNet-vs-P100 anchor (BASELINE.md has no
     # reference LM throughput to anchor tokens/s against); the nested
     # record carries its full provenance

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Imperative training with a custom ``autograd.Function``::
+
+    python examples/train_autograd_function.py --num-epochs 15
+
+Reference analog: ``python/mxnet/autograd.py:291`` (``Function``) —
+user-defined forward/backward spliced into the imperative tape.  The
+hidden activation here is a BinaryNet-style sign with a
+straight-through estimator: the true derivative is zero almost
+everywhere, so ordinary autograd cannot train through it; the custom
+``backward`` passes the clipped cotangent instead.  The loop is fully
+imperative (``attach_grad`` + ``record`` + ``backward`` + manual SGD)
+— no Module, no Symbol.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import common  # noqa: E402,F401  (TP_EXAMPLES_FORCE_CPU device pin)
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import autograd as ag  # noqa: E402
+
+
+class binary_act(ag.Function):
+    """sign(x) forward; straight-through backward, gated to |x| <= 1
+    (the BinaryNet hard-tanh window)."""
+
+    def forward(self, x):
+        self.save_for_backward(x)
+        return mx.nd.sign(x)
+
+    def backward(self, dy):
+        x, = self.saved_tensors
+        gate = mx.nd.array(
+            (np.abs(x.asnumpy()) <= 1.0).astype(np.float32))
+        return dy * gate
+
+
+def _softmax_xent(logits, labels_onehot):
+    z = logits - mx.nd.max(logits, axis=1, keepdims=True)
+    lse = mx.nd.log(mx.nd.sum(mx.nd.exp(z), axis=1, keepdims=True))
+    return -mx.nd.sum(labels_onehot * (z - lse)) / logits.shape[0]
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="imperative straight-through training")
+    ap.add_argument("--num-examples", type=int, default=512)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=15)
+    ap.add_argument("--num-hidden", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    if args.num_examples < args.batch_size:
+        ap.error("--num-examples must be >= --batch-size")
+
+    rng = np.random.RandomState(0)
+    classes, feat = 4, 16
+    W = rng.randn(feat, classes)
+    X = rng.randn(args.num_examples, feat).astype(np.float32)
+    y = np.argmax(X @ W, 1)
+    onehot = np.eye(classes, dtype=np.float32)[y]
+
+    params = [
+        mx.nd.array(rng.randn(feat, args.num_hidden)
+                    .astype(np.float32) * 0.3),
+        mx.nd.array(np.zeros((1, args.num_hidden), np.float32)),
+        mx.nd.array(rng.randn(args.num_hidden, classes)
+                    .astype(np.float32) * 0.3),
+        mx.nd.array(np.zeros((1, classes), np.float32)),
+    ]
+    for p in params:
+        p.attach_grad()
+
+    def net(xb):
+        h = binary_act()(mx.nd.dot(xb, params[0]) + params[1])
+        return mx.nd.dot(h, params[2]) + params[3]
+
+    B = args.batch_size
+    nb = args.num_examples // B
+    acc = 0.0
+    for epoch in range(args.num_epochs):
+        correct = total = 0
+        for b in range(nb):
+            sl = slice(b * B, (b + 1) * B)
+            xb = mx.nd.array(X[sl])
+            with ag.record():
+                logits = net(xb)
+                loss = _softmax_xent(logits, mx.nd.array(onehot[sl]))
+            loss.backward()
+            for p in params:  # plain SGD on the accumulated grads
+                p._set_data(p.data - args.lr * p.grad.data)
+            pred = logits.asnumpy().argmax(1)
+            correct += (pred == y[sl]).sum()
+            total += pred.size
+        acc = correct / total
+        logging.info("Epoch[%d] Train-accuracy=%.4f", epoch, acc)
+    assert acc > 0.7, acc
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

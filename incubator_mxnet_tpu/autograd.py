@@ -17,7 +17,7 @@ from .base import MXNetError
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "mark_variables", "backward", "grad",
-           "set_recording", "set_training"]
+           "set_recording", "set_training", "Function"]
 
 _state = threading.local()
 
@@ -97,7 +97,7 @@ class TapeNode:
     """AGNode analog: one recorded op application."""
 
     __slots__ = ("op", "attrs", "opctx", "inputs", "input_vals", "n_args",
-                 "out_entries")
+                 "out_entries", "custom", "out_info")
 
     def __init__(self, op, attrs, opctx, inputs, input_vals, n_args):
         self.op = op
@@ -106,6 +106,8 @@ class TapeNode:
         self.inputs = inputs          # list of NDArray (strong refs)
         self.input_vals = input_vals  # jax arrays captured at record time
         self.n_args = n_args          # inputs beyond this are aux (no grads)
+        self.custom = None            # Function instance (custom backward)
+        self.out_info = None          # [(shape, dtype)] per recorded output
 
 
 def record_op(op, attrs, opctx, input_nds, input_vals, output_nds,
@@ -116,6 +118,66 @@ def record_op(op, attrs, opctx, input_nds, input_vals, output_nds,
                     list(input_vals), n_args)
     for i, o in enumerate(output_nds):
         o._ag_entry = (node, i)
+
+
+class Function:
+    """Customized differentiation (reference ``python/mxnet/autograd.py:291``).
+
+    Subclass and override :meth:`forward` and :meth:`backward`; both run
+    on NDArrays with recording paused, so anything computed inside them
+    is invisible to the tape — the user-supplied ``backward`` is the
+    gradient, wholesale.  Use when the true derivative is not what you
+    want autograd to propagate (straight-through estimators, numerically
+    stabilized forms, gradient clipping/reversal at a cut point)::
+
+        class sigmoid(mx.autograd.Function):
+            def forward(self, x):
+                y = 1 / (1 + mx.nd.exp(-x))
+                self.save_for_backward(y)
+                return y
+
+            def backward(self, dy):
+                y, = self.saved_tensors
+                return dy * y * (1 - y)
+
+    Each instance records at most one call (state such as
+    ``saved_tensors`` belongs to that call); instantiate per use.
+    ``backward`` must return one gradient per ``forward`` input (or
+    ``None`` to send no gradient into that input).
+    """
+
+    def __init__(self):
+        self._recorded = False
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *args):
+        """Stash tensors for :meth:`backward` (``self.saved_tensors``)."""
+        self.saved_tensors = args
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        if self._recorded:
+            raise MXNetError(
+                "a Function instance records a single call; make a new "
+                "%s() per application" % type(self).__name__)
+        with pause(train_mode=is_training()):
+            outputs = self.forward(*inputs)
+        if not is_recording():
+            return outputs
+        self._recorded = True
+        outs = outputs if isinstance(outputs, (list, tuple)) else (outputs,)
+        node = TapeNode(None, {}, None, list(inputs),
+                        [x.data for x in inputs], len(inputs))
+        node.custom = self
+        node.out_info = [(o.shape, o.data.dtype) for o in outs]
+        for i, o in enumerate(outs):
+            o._ag_entry = (node, i)
+        return outputs
 
 
 def mark_variables(variables: Sequence[Any], gradients: Sequence[Any],
@@ -181,9 +243,43 @@ def backward(heads: Sequence[Any], head_grads: Optional[Sequence[Any]] = None,
         key = _entry_key(h)
         cotan[key] = cotan.get(key, 0) + g
 
+    def accumulate(inp, g):
+        entry = getattr(inp, "_ag_entry", None)
+        if entry is None:
+            return
+        if entry[0] == "var":
+            if inp._grad_req == "null" or inp.grad is None:
+                return
+            var_accum[id(inp)] = var_accum.get(id(inp), 0) + g
+            var_objs[id(inp)] = inp
+        else:
+            key = (id(entry[0]), entry[1])
+            cotan[key] = (cotan[key] + g) if key in cotan else g
+
     for node in reversed(order):
         nid = id(node)
         if not any(k[0] == nid for k in cotan):
+            continue
+
+        if node.custom is not None:
+            # Function node: the user-supplied backward IS the vjp
+            out_grads = tuple(
+                NDArray(jnp.asarray(cotan[(nid, i)], dtype)
+                        if (nid, i) in cotan else jnp.zeros(shape, dtype))
+                for i, (shape, dtype) in enumerate(node.out_info))
+            with pause():
+                in_grads = node.custom.backward(*out_grads)
+            if not isinstance(in_grads, (list, tuple)):
+                in_grads = (in_grads,)
+            if len(in_grads) != node.n_args:
+                raise MXNetError(
+                    "%s.backward returned %d gradient(s) for %d "
+                    "forward input(s)" % (type(node.custom).__name__,
+                                          len(in_grads), node.n_args))
+            for inp, g in zip(node.inputs, in_grads):
+                if g is not None:
+                    accumulate(inp, g.data if isinstance(g, NDArray)
+                               else jnp.asarray(g))
             continue
 
         primals = tuple(node.input_vals[:node.n_args])
@@ -204,17 +300,7 @@ def backward(heads: Sequence[Any], head_grads: Optional[Sequence[Any]] = None,
         in_grads = vjp_fn(full_ct)
 
         for inp, g in zip(node.inputs[:node.n_args], in_grads):
-            entry = getattr(inp, "_ag_entry", None)
-            if entry is None:
-                continue
-            if entry[0] == "var":
-                if inp._grad_req == "null" or inp.grad is None:
-                    continue
-                var_accum[id(inp)] = var_accum.get(id(inp), 0) + g
-                var_objs[id(inp)] = inp
-            else:
-                key = (id(entry[0]), entry[1])
-                cotan[key] = (cotan[key] + g) if key in cotan else g
+            accumulate(inp, g)
 
     for vid, g in var_accum.items():
         v = var_objs[vid]

@@ -1,24 +1,46 @@
-"""SequentialModule — chain of modules, each feeding the next.
+"""SequentialModule — a container that chains modules head-to-tail.
 
-Reference analog: ``python/mxnet/module/sequential_module.py:28``.  The
-outputs of module ``i`` become the data of module ``i+1``; labels (if
-taken) go to the last module that declares label_names.
+Reference analog: ``python/mxnet/module/sequential_module.py:28``.
+Module ``i``'s outputs become module ``i+1``'s data; the iterator
+labels are routed to whichever member was added with
+``take_labels=True`` (typically the loss head).  Together with
+:class:`~.python_module.PythonLossModule` this lets a python-side loss
+ride behind a compiled Symbol module — see
+``tests/test_module_variants.py`` and ``examples/train_stochastic_depth.py``.
 """
 from __future__ import annotations
 
 import copy
 import logging
 
+from ..base import MXNetError
 from ..initializer import Uniform
-from ..io import DataBatch
 from .base_module import BaseModule
 
 __all__ = ["SequentialModule"]
 
 
+def _require(ok, what):
+    """State-ordering guard (bind → init_params → init_optimizer)."""
+    if not ok:
+        raise MXNetError("SequentialModule: %s" % what)
+
+
 class SequentialModule(BaseModule):
+    """A chain of :class:`BaseModule` members executed in order.
+
+    ``add`` accepts two per-member options:
+
+    * ``take_labels`` — this member receives the data iterator's
+      labels at bind time (and feeds ``update_metric``).
+    * ``auto_wiring`` — the previous member's output shapes are
+      renamed to this member's ``data_names`` before binding, so the
+      chain composes without hand-matching tensor names.
+    """
+
     META_TAKE_LABELS = "take_labels"
     META_AUTO_WIRING = "auto_wiring"
+    _META_KEYS = frozenset((META_TAKE_LABELS, META_AUTO_WIRING))
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
@@ -26,93 +48,88 @@ class SequentialModule(BaseModule):
         self._metas = []
         self._label_shapes = None
         self._data_shapes = None
-        self._meta_keys = {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
 
     def add(self, module, **kwargs):
-        """Append ``module``; kwargs: ``take_labels=True`` marks the module
-        that consumes the iterator labels, ``auto_wiring=True`` renames the
-        previous module's outputs to this module's data names."""
+        """Append ``module`` (returns ``self`` for chaining)."""
+        bad = sorted(set(kwargs) - self._META_KEYS)
+        if bad:
+            raise MXNetError(
+                "SequentialModule.add got unexpected option(s) %s; "
+                "supported: %s" % (bad, sorted(self._META_KEYS)))
         self._modules.append(module)
-        for key in kwargs:
-            assert key in self._meta_keys, \
-                "Unknown meta \"%s\", a typo?" % key
         self._metas.append(kwargs)
-        # bookkeeping resets — adding modules invalidates binding
+        # growing the chain invalidates any previous bind/init
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
-        return self  # allow chaining
+        return self
 
     # ------------------------------------------------------------- shapes
     @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._modules[0].data_names if self._modules else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._modules[-1].output_names if self._modules else []
 
     @property
     def data_shapes(self):
-        assert self.binded
+        _require(self.binded, "data_shapes requires bind")
         return self._modules[0].data_shapes
 
     @property
     def label_shapes(self):
-        assert self.binded
+        _require(self.binded, "label_shapes requires bind")
         return self._label_shapes
 
     @property
     def output_shapes(self):
-        assert self.binded
+        _require(self.binded, "output_shapes requires bind")
         return self._modules[-1].output_shapes
 
     # ------------------------------------------------------------- params
     def get_params(self):
-        assert self.binded and self.params_initialized
+        _require(self.binded and self.params_initialized,
+                 "get_params requires bind + init_params")
         arg_params, aux_params = {}, {}
         for module in self._modules:
             arg, aux = module.get_params()
             arg_params.update(arg)
             aux_params.update(aux)
-        return (arg_params, aux_params)
+        return arg_params, aux_params
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False,
                     force_init=False, allow_extra=False):
         if self.params_initialized and not force_init:
             return
-        assert self.binded, "call bind before initializing the parameters"
+        _require(self.binded, "init_params requires bind")
         for module in self._modules:
+            # allow_missing=True per member: a chain-level param dict
+            # only covers each member's slice of the names
             module.init_params(initializer=initializer,
                                arg_params=arg_params,
                                aux_params=aux_params,
                                allow_missing=True,
                                force_init=force_init)
-
-        # make sure we do not have duplicated parameter names
-        def _check_name(known_names, new_names, modules, i):
-            for name in new_names:
-                assert not name in known_names, \
-                    "Duplicated parameter names: " + \
-                    ("name \"%s\" in layer %d (%s) is already used in " +
-                     "layer %d (%s)") % (
-                        name, i, type(modules[i]),
-                        known_names[name], type(modules[known_names[name]]))
-                known_names[name] = i
-
-        arg_names, aux_names = dict(), dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params, aux_params = module.get_params()
-            _check_name(arg_names, arg_params.keys(), self._modules,
-                        i_layer)
-            _check_name(aux_names, aux_params.keys(), self._modules,
-                        i_layer)
+        self._check_duplicate_names()
         self.params_initialized = True
+
+    def _check_duplicate_names(self):
+        """Reject a chain whose members share a parameter name — the
+        merged ``get_params`` dict would silently drop one of them."""
+        owner = {}
+        for i, module in enumerate(self._modules):
+            arg, aux = module.get_params()
+            for name in list(arg) + list(aux):
+                if name in owner:
+                    raise MXNetError(
+                        "duplicated parameter name '%s': member %d "
+                        "(%s) reuses it from member %d (%s)" % (
+                            name, i, type(module).__name__, owner[name],
+                            type(self._modules[owner[name]]).__name__))
+                owner[name] = i
 
     # ------------------------------------------------------------- binding
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -121,57 +138,54 @@ class SequentialModule(BaseModule):
         if self.binded and not force_rebind:
             self.logger.warning("Already bound, ignoring bind()")
             return
-        if inputs_need_grad:
-            assert for_training
-        assert shared_module is None, \
-            "Shared module is not supported"
-        assert len(self._modules) > 0, "Attempting to bind an empty " \
-            "SequentialModule"
+        if inputs_need_grad and not for_training:
+            raise MXNetError("inputs_need_grad requires for_training")
+        if shared_module is not None:
+            raise MXNetError(
+                "SequentialModule does not support shared_module")
+        _require(self._modules, "bind called on an empty chain")
 
         self.binded = True
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self._label_shapes = label_shapes
 
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if self.META_TAKE_LABELS in meta and \
-                    meta[self.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
-
-            my_inputs_need_grad = bool(for_training and (
-                inputs_need_grad or i_layer > 0))
-
+        feed = data_shapes
+        label_taken = False
+        for i, (module, meta) in enumerate(zip(self._modules,
+                                               self._metas)):
+            takes = bool(meta.get(self.META_TAKE_LABELS, False))
+            label_taken = label_taken or takes
             if meta.get(self.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [(new_name, d[1])
-                                  for new_name, d in
-                                  zip(data_names, my_data_shapes)]
+                names = module.data_names
+                if len(names) != len(feed):
+                    raise MXNetError(
+                        "auto_wiring: member %d expects %d inputs, "
+                        "previous member produces %d" % (
+                            i, len(names), len(feed)))
+                feed = [(name, shape[1])
+                        for name, shape in zip(names, feed)]
+            module.bind(
+                data_shapes=feed,
+                label_shapes=label_shapes if takes else None,
+                for_training=for_training,
+                # interior members always need input grads to keep the
+                # backward chain flowing; the head only on request
+                inputs_need_grad=bool(for_training and
+                                      (inputs_need_grad or i > 0)),
+                force_rebind=force_rebind, shared_module=None,
+                grad_req=grad_req)
+            feed = module.output_shapes
 
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-
-            # the output of the previous module is the data of the next
-            my_data_shapes = module.output_shapes
-
-        if not anybody_ever_needs_label:
+        if not label_taken:
             self._label_shapes = None
 
     # ----------------------------------------------------------- optimizer
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
-        assert self.binded and self.params_initialized
+        _require(self.binded and self.params_initialized,
+                 "init_optimizer requires bind + init_params")
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
@@ -183,50 +197,55 @@ class SequentialModule(BaseModule):
 
     # ----------------------------------------------------------- execution
     def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
-        data_batch = copy.copy(data_batch)
-        for i_layer, module in enumerate(self._modules):
-            module.forward(data_batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
-                break
-            data_batch.data = module.get_outputs()
-            if hasattr(data_batch, "provide_data"):
-                data_names = [x[0] for x in module.output_shapes]
-                assert len(data_names) == len(data_batch.data)
-                data_batch.provide_data = [
-                    (name, x.shape)
-                    for name, x in zip(data_names, data_batch.data)]
+        _require(self.binded and self.params_initialized,
+                 "forward requires bind + init_params")
+        batch = copy.copy(data_batch)
+        for i, module in enumerate(self._modules):
+            module.forward(batch, is_train=is_train)
+            if i + 1 == len(self._modules):
+                return
+            batch.data = module.get_outputs()
+            if hasattr(batch, "provide_data"):
+                batch.provide_data = [
+                    (shape[0], out.shape) for shape, out in
+                    zip(module.output_shapes, batch.data)]
 
     def backward(self, out_grads=None):
-        assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(enumerate(self._modules))):
-            module.backward(out_grads=out_grads)
-            if i_layer == 0:
+        _require(self.binded and self.params_initialized,
+                 "backward requires bind + init_params")
+        for i in reversed(range(len(self._modules))):
+            self._modules[i].backward(out_grads=out_grads)
+            if i == 0:
                 break
-            out_grads = module.get_input_grads()
+            out_grads = self._modules[i].get_input_grads()
 
     def update(self):
-        assert self.binded and self.params_initialized and \
-            self.optimizer_initialized
+        _require(self.binded and self.params_initialized and
+                 self.optimizer_initialized,
+                 "update requires bind + init_params + init_optimizer")
         for module in self._modules:
             module.update()
 
     def get_outputs(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
+        _require(self.binded and self.params_initialized,
+                 "get_outputs requires bind + init_params")
         return self._modules[-1].get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and \
-            self.inputs_need_grad
+        _require(self.binded and self.params_initialized,
+                 "get_input_grads requires bind + init_params")
+        _require(self.inputs_need_grad,
+                 "get_input_grads requires inputs_need_grad=True at bind")
         return self._modules[0].get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
-        assert self.binded and self.params_initialized
+        _require(self.binded and self.params_initialized,
+                 "update_metric requires bind + init_params")
         for meta, module in zip(self._metas, self._modules):
             if meta.get(self.META_TAKE_LABELS, False):
                 module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
-        assert self.binded
+        _require(self.binded, "install_monitor requires bind")
         for module in self._modules:
             module.install_monitor(mon)

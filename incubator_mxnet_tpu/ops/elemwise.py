@@ -7,6 +7,8 @@ the TPU-native replacement for mshadow expression templates.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -136,6 +138,59 @@ def _make_loss(ins, attrs, ctx):
 
 _unary("BlockGrad", jax.lax.stop_gradient, aliases=["stop_gradient"])
 _unary("identity", lambda x: x, aliases=["_copy"])
+
+
+@functools.lru_cache(maxsize=None)
+def _kl_sparse_fn(target, penalty):
+    @jax.custom_vjp
+    def f(x, avg):
+        return x
+
+    def f_fwd(x, avg):
+        return x, avg
+
+    def f_bwd(avg, g):
+        # d/da KL(t ‖ a) = −t/a + (1−t)/(1−a), broadcast over the batch
+        # rows; avg is the stored statistic, a constant w.r.t. x
+        pen = penalty * (-target / avg + (1.0 - target) / (1.0 - avg))
+        return g + pen[None].astype(g.dtype), jnp.zeros_like(avg)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def _kl_sparse_infer_shape(in_shapes, attrs):
+    data_s = in_shapes[0]
+    if data_s is None:
+        return in_shapes, [None], [None]
+    return [data_s], [data_s], [tuple(data_s[1:])]
+
+
+@register("IdentityAttachKLSparseReg", arg_names=["data"],
+          aux_names=["moving_avg"], infer_shape=_kl_sparse_infer_shape)
+def _identity_attach_kl_sparse_reg(ins, attrs, ctx):
+    """Identity forward with a KL sparseness penalty attached to the
+    gradient (``src/operator/identity_attach_KL_sparse_reg-inl.h``).
+
+    Inputs are sigmoid activations in (0, 1); the aux ``moving_avg``
+    tracks the per-unit batch mean activation with ``momentum``, and
+    the backward adds ``penalty * d/da KL(sparseness_target ‖ avg)``
+    to every row — with momentum=0 this is the exact gradient of
+    ``penalty * B * Σ_j KL(t ‖ colmean_j(x))``.  Attrs (reference
+    defaults): sparseness_target=0.1, penalty=0.001, momentum=0.9.
+    """
+    data, moving_avg = ins
+    target = parse_float(attrs.get("sparseness_target", 0.1))
+    penalty = parse_float(attrs.get("penalty", 0.001))
+    momentum = parse_float(attrs.get("momentum", 0.9))
+    if ctx.is_train:
+        batch_mean = jnp.mean(data.astype(moving_avg.dtype), axis=0)
+        new_avg = moving_avg * momentum + batch_mean * (1.0 - momentum)
+    else:
+        new_avg = moving_avg
+    new_avg = jax.lax.stop_gradient(new_avg)
+    out = _kl_sparse_fn(target, penalty)(data, new_avg)
+    return (out,), (new_avg,)
 
 
 @register("Cast", arg_names=["data"], aliases=["cast"])

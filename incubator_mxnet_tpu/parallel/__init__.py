@@ -23,7 +23,8 @@ from .fused import FusedTrainStep
 from .sequence import (attention, ring_attention, ulysses_attention,
                        sequence_parallel_attention)
 from .pipeline import (pipeline_apply, pipeline_parallel_apply,
-                       PipelineTrainStep)
+                       PipelineTrainStep, pp_bubble_fraction,
+                       pp_schedule)
 from .pipeline_symbol import SymbolPipelineTrainStep
 from .moe import moe_ffn, expert_parallel_moe
 from .vocab_parallel import vocab_parallel_softmax_xent
@@ -34,5 +35,6 @@ __all__ = ["build_mesh", "default_mesh", "data_parallel_spec",
            "barrier_sync", "FusedTrainStep", "attention", "ring_attention",
            "ulysses_attention", "sequence_parallel_attention",
            "pipeline_apply", "pipeline_parallel_apply",
-           "PipelineTrainStep", "SymbolPipelineTrainStep", "moe_ffn",
+           "PipelineTrainStep", "SymbolPipelineTrainStep",
+           "pp_bubble_fraction", "pp_schedule", "moe_ffn",
            "expert_parallel_moe", "save_sharded", "restore_sharded"]

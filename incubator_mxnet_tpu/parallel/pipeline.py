@@ -34,7 +34,86 @@ import numpy as np
 from .mesh import axis_size as _axis_size
 
 __all__ = ["pipeline_apply", "pipeline_parallel_apply",
-           "PipelineTrainStep"]
+           "PipelineTrainStep", "pp_bubble_fraction", "pp_schedule",
+           "PP_SCHEDULES"]
+
+# the schedules the symbol pipeline engine knows how to table out
+PP_SCHEDULES = ("gpipe", "1f1b")
+
+
+def pp_bubble_fraction(n_stages: int, n_microbatches: int) -> float:
+    """Steady-state pipeline bubble fraction (L−1)/(M+L−1).
+
+    Identical for GPipe and 1F1B (Narayanan et al., SC'21 §2.2) — the
+    two schedules differ in *memory* (in-flight activations per stage:
+    M vs ≤ L−s), not in idle-tick count.
+    """
+    L, M = int(n_stages), int(n_microbatches)
+    return (L - 1) / float(M + L - 1)
+
+
+def pp_schedule(schedule: str, n_stages: int, n_microbatches: int):
+    """Tick tables for the SPMD symbol-pipeline engine.
+
+    Both schedules run the same T = 2·(M+L−1) ticks (M forward and M
+    backward ops per stage plus (L−1) fwd + (L−1) bwd bubble ticks);
+    what differs is WHEN each stage runs which op:
+
+    - ``gpipe`` (Huang et al., NeurIPS'19): all forwards first —
+      F(s,m) = s+m, then all backwards — B(s,m) = (M+L−1)+(L−1−s)+m.
+      Every stage stashes all M boundary inputs.
+    - ``1f1b`` (Narayanan et al., SC'21): stage s runs L−1−s warm-up
+      forwards (F = s+m), then alternates one-forward-one-backward
+      (F(s,m) = s+2m, B(s,m) = 2L−1−s+2m), then drains.  At most
+      L−s microbatches are in flight at stage s, so min(L, M) stash
+      slots suffice — reused round-robin by ``m % n_slots``.
+
+    Returns ``(op, mb, arrive, n_slots)``: int32 numpy arrays of shape
+    (T, L).  ``op[t, s]`` is 0 idle / 1 forward / 2 backward;
+    ``mb[t, s]`` the microbatch index of that op; ``arrive[t, s]`` the
+    stash slot receiving the boundary activation hopping in from stage
+    s−1 this tick (= ``n_slots``, a scratch row, when none arrives).
+    Dependency timing is exact by construction: the boundary for
+    (s, m) lands at tick F(s−1,m)+1 ≤ F(s,m), and the cotangent for
+    (s, m) lands at tick B(s+1,m)+1 = B(s,m).
+    """
+    L, M = int(n_stages), int(n_microbatches)
+    if schedule == "gpipe":
+        n_slots = M
+
+        def fwd_tick(s, m):
+            return s + m
+
+        def bwd_tick(s, m):
+            return (M + L - 1) + (L - 1 - s) + m
+    elif schedule == "1f1b":
+        n_slots = min(L, M)
+
+        def fwd_tick(s, m):
+            return s + m if m < L - 1 - s else s + 2 * m
+
+        def bwd_tick(s, m):
+            return 2 * L - 1 - s + 2 * m
+    else:
+        raise ValueError("unknown pipeline schedule %r (one of %s)"
+                         % (schedule, ", ".join(PP_SCHEDULES)))
+
+    T = 2 * (M + L - 1)
+    op = np.zeros((T, L), np.int32)
+    mb = np.zeros((T, L), np.int32)
+    arrive = np.full((T, L), n_slots, np.int32)
+    for s in range(L):
+        for m in range(M):
+            tf, tb = fwd_tick(s, m), bwd_tick(s, m)
+            if op[tf, s] or op[tb, s] or tf >= tb:
+                raise ValueError(
+                    "internal: %s schedule conflict at stage %d mb %d"
+                    % (schedule, s, m))
+            op[tf, s], mb[tf, s] = 1, m
+            op[tb, s], mb[tb, s] = 2, m
+            if s + 1 < L:
+                arrive[tf + 1, s + 1] = m % n_slots
+    return op, mb, arrive, n_slots
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,

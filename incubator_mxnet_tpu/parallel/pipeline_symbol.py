@@ -7,12 +7,12 @@ machinery (``src/executor/graph_executor.cc:279-393`` AssignContext +
 ``_CrossDeviceCopy``): the topo order is cut at single-live-tensor
 boundaries into L contiguous stages, device *i* holds stage *i*'s
 parameters (packed into one flat row of a (L, maxP) buffer sharded
-``P('pp')``), and ONE jitted SPMD program runs the GPipe tick loop —
-``lax.switch`` on the pipeline ``axis_index`` dispatches the local
-stage body, ``lax.ppermute`` carries the boundary activation to the
-next device over ICI, gradients accumulate across microbatch ticks
-inside the program, and the same fused optimizer ops as
-``FusedTrainStep`` apply elementwise on the stacked flat buffers.
+``P('pp')``), and ONE jitted SPMD program runs the schedule's tick
+loop — ``lax.switch`` on the pipeline ``axis_index`` dispatches the
+local stage body, ``lax.ppermute`` carries boundary activations
+forward and cotangents backward over ICI, gradients accumulate across
+microbatch ticks inside the program, and the same fused optimizer ops
+as ``FusedTrainStep`` apply elementwise on the stacked flat buffers.
 
 Key mechanics (and why):
 
@@ -25,18 +25,35 @@ Key mechanics (and why):
   program, so stage bodies become branches of one ``lax.switch``; the
   boundary activation travels flattened+padded to the widest cut
   (f32), each branch unflattening its own side's shape/dtype.
-- **Loss-head gradient gating**: the framework's loss ops
-  (``SoftmaxOutput`` family, the fused xent head) carry custom VJPs
-  that IGNORE the incoming cotangent (reference semantics), so a
-  bubble tick through the last stage would inject garbage analytic
-  gradients that no outer ``where`` can kill.  Every input of the
-  last stage (params, boundary, microbatch) therefore passes through
-  a gate that is identity forward and ``cotangent × valid`` backward,
-  and the bubble boundary is zeroed so the dead math stays finite.
-- **Aux (BN) threading**: each stage updates its local aux only on
-  REAL ticks (bubble executions are masked out), in microbatch order —
-  exactly ``FusedTrainStep(grad_accum=M)``'s sequential-scan semantics,
-  which is the oracle the parity tests use.
+- **Explicit tick→(microbatch, direction) engine**: the schedule
+  table (``pipeline.pp_schedule``) assigns every tick of every stage
+  an op — idle, forward, or backward — so bubble ticks are true no-op
+  branches instead of masked garbage math.  A forward tick banks its
+  boundary input and pre-update aux in a stash slot; the matching
+  backward tick recomputes the stage forward from those exact stashed
+  inputs under ``jax.vjp``, seeds the loss cotangent with the constant
+  1, sums the parameter cotangent into the flat grad row, and
+  ppermutes the boundary cotangent upstream.  Per-stage gradients
+  therefore accumulate in INCREASING microbatch order under BOTH
+  schedules — the ``FusedTrainStep(grad_accum=M)`` oracle's order —
+  which is what makes ``schedule="1f1b"`` bit-equal to ``"gpipe"``.
+- **Schedules** (``schedule=`` / ``TP_PP_SCHEDULE``): ``gpipe`` runs
+  all forwards then all backwards, stashing all M boundary
+  activations per stage; ``1f1b`` alternates one-forward-one-backward
+  after L−1−s warm-up forwards, holding at most L−s in-flight
+  microbatches per stage so min(L, M) stash slots suffice (Narayanan
+  et al., SC'21).  Same bubble fraction (L−1)/(M+L−1), O(L) instead
+  of O(M) activation memory — see docs/pipeline.md.
+- **Loss heads**: ops whose custom VJP ignores the incoming cotangent
+  (``SoftmaxOutput`` family, the fused xent head — reference
+  semantics) must land in the FINAL stage, where the backward seed is
+  the exact constant 1; earlier stages receive real cotangents that
+  must flow through the boundary.
+- **Aux (BN) threading**: each stage updates its local aux at its
+  forward ticks, in microbatch order — exactly
+  ``FusedTrainStep(grad_accum=M)``'s sequential-scan semantics, which
+  is the oracle the parity tests use — and each backward recomputes
+  from the aux values its forward actually saw.
 """
 from __future__ import annotations
 
@@ -45,6 +62,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import telemetry
 from ..base import MXNetError, get_env
 from ..lowering import _interpret
 from ..ops.registry import OpContext, get_op
@@ -53,36 +71,12 @@ __all__ = ["SymbolPipelineTrainStep"]
 
 # ops whose custom VJP ignores the incoming cotangent (analytic loss
 # grads, reference semantics) — allowed in the LAST stage only, where
-# the gate masks their bubble-tick gradients
+# the backward seed is the exact constant 1
 _LOSS_HEAD_OPS = frozenset({
     "SoftmaxOutput", "LinearRegressionOutput", "MAERegressionOutput",
     "LogisticRegressionOutput", "SVMOutput", "make_loss",
     "_contrib_SoftmaxXentHead",
 })
-
-_gate_cache = []
-
-
-def _grad_gate():
-    """identity forward; backward multiplies the cotangent by ``m``
-    (0.0 on bubble ticks) — see the module docstring."""
-    if _gate_cache:
-        return _gate_cache[0]
-    import jax
-
-    @jax.custom_vjp
-    def gate(x, m):
-        return x
-
-    def fwd(x, m):
-        return x, m
-
-    def bwd(m, ct):
-        return ct * m.astype(ct.dtype), None
-
-    gate.defvjp(fwd, bwd)
-    _gate_cache.append(gate)
-    return gate
 
 
 def _plan_stages(symbol, micro_shapes: Dict[str, Tuple[int, ...]],
@@ -287,14 +281,18 @@ def _plan_stages(symbol, micro_shapes: Dict[str, Tuple[int, ...]],
 
 
 class SymbolPipelineTrainStep:
-    """GPipe-pipelined training of an arbitrary Symbol over a ``pp``
-    mesh axis, composing with data parallelism on the remaining axes.
+    """Pipelined training of an arbitrary Symbol over a ``pp`` mesh
+    axis, composing with data parallelism on the remaining axes.
 
     ``num_microbatches`` microbatches flow through ``mesh.shape[pp]``
-    stages; gradients sum across microbatches inside one jitted step
-    (aux/BN semantics identical to ``FusedTrainStep(grad_accum=M)``,
-    the oracle its tests compare against), then one fused optimizer
-    update applies on the stage-stacked flat parameter buffer.
+    stages under ``schedule`` — ``"gpipe"`` (default; all forwards
+    then all backwards) or ``"1f1b"`` (one-forward-one-backward
+    steady state, O(stages) instead of O(M) in-flight activations per
+    stage, bit-equal losses and parameters).  Gradients sum across
+    microbatches inside one jitted step (aux/BN semantics identical
+    to ``FusedTrainStep(grad_accum=M)``, the oracle its tests compare
+    against), then one fused optimizer update applies on the
+    stage-stacked flat parameter buffer.
 
     Supports the same optimizer set as ``FusedTrainStep``
     (sgd/adam/rmsprop/nag/ftrl + lr_scheduler).
@@ -307,12 +305,14 @@ class SymbolPipelineTrainStep:
                  optimizer: str = "sgd",
                  optimizer_params: Optional[Dict[str, Any]] = None,
                  initializer=None, seed: int = 0,
-                 shard_optimizer: Optional[bool] = None):
+                 shard_optimizer: Optional[bool] = None,
+                 schedule: Optional[str] = None):
         import jax
 
         from ..optimizer import fused_update_plan as _fused_update_plan
         from .fused import _device_init_plan
         from .mesh import default_mesh
+        from .pipeline import PP_SCHEDULES, pp_bubble_fraction
 
         self.symbol = symbol
         self.mesh = mesh if mesh is not None else default_mesh()
@@ -321,6 +321,22 @@ class SymbolPipelineTrainStep:
         self.axis_name = axis_name
         self._L = int(self.mesh.shape[axis_name])
         self._M = int(num_microbatches)
+        # tick schedule: explicit argument wins, then TP_PP_SCHEDULE
+        if schedule is None:
+            schedule = get_env("PP_SCHEDULE", "gpipe", str)
+        schedule = str(schedule).lower()
+        if schedule not in PP_SCHEDULES:
+            raise MXNetError(
+                "unknown pipeline schedule %r (one of %s; see "
+                "docs/pipeline.md)" % (schedule,
+                                       ", ".join(PP_SCHEDULES)))
+        self.schedule = schedule
+        self.bubble_fraction = pp_bubble_fraction(self._L, self._M)
+        if telemetry.enabled():
+            telemetry.gauge(
+                "pp_bubble_fraction",
+                {"schedule": schedule, "scope": "pipeline"}).set(
+                self.bubble_fraction)
         self._data_axes = tuple(a for a in self.mesh.axis_names
                                 if a != axis_name)
         ndp = 1
@@ -458,6 +474,8 @@ class SymbolPipelineTrainStep:
             self.opt_states = ()
         self.optimizer_state_bytes()  # publish the footprint gauges
         self._key = jax.random.PRNGKey(seed + 1)
+        self._mem_stats = None  # lazy AOT memory analysis cache
+        self.microbatch_losses = None
         self._step_fn = self._build()
 
     # ------------------------------------------------------------ build
@@ -467,47 +485,41 @@ class SymbolPipelineTrainStep:
         from jax import lax
 
         from .mesh import shard_map_fn
+        from .pipeline import pp_schedule
 
         plan = self._plan
         L, M = self._L, self._M
         axis = self.axis_name
         data_axes = self._data_axes
         maxB = plan["max_boundary"]
+        maxP = plan["max_psize"]
+        maxA = plan["max_asize"]
         aux_names = plan["aux_names"]
         out_entries = set(plan["out_entries"])
-        id2pos = plan["id2pos"]
-        gate = _grad_gate()
 
-        def make_branch(s):
+        # tick → (op, microbatch, arrival-slot) tables, shape (T, L);
+        # each device reads its own column by pipeline axis_index
+        op_np, mb_np, arr_np, n_slots = pp_schedule(self.schedule, L, M)
+        n_ticks = op_np.shape[0]
+
+        def make_stage_fwd(s):
             seg_nodes = tuple(plan["stage_nodes"][s])
             playout = tuple(plan["stage_params"][s])
             alayout = tuple(plan["stage_aux"][s])
             bin_ = plan["boundaries"][s - 1] if s > 0 else None
             bout = plan["boundaries"][s] if s < L - 1 else None
-            is_last = s == L - 1
 
-            def branch(local_p, local_aux, state_in, t, data, key):
-                slot = jnp.clip(t - s, 0, M - 1)
-                valid = ((t - s >= 0) & (t - s < M)) \
-                    .astype(jnp.float32)
-                mb = {k: v[slot] for k, v in data.items()}
-                if is_last:
-                    # loss-head custom VJPs ignore the cotangent: gate
-                    # every input so bubble-tick analytic grads vanish,
-                    # and zero the bubble boundary to keep them finite
-                    local_p = gate(local_p, valid)
-                    state_in = state_in * valid
-                    mb = {k: gate(v, valid) for k, v in mb.items()}
+            def stage_fwd(local_p, b_in, mb, aux_flat, key):
                 args = {n: local_p[off:off + sz].reshape(shp)
                         for n, off, sz, shp in playout}
                 args.update(mb)
-                aux_vals = {n: local_aux[off:off + sz].reshape(shp)
+                aux_vals = {n: aux_flat[off:off + sz].reshape(shp)
                             for n, off, sz, shp in alayout}
                 env = {}
                 if bin_ is not None:
                     (pos, i), shp, dt, sz = bin_
                     node = plan["nodes"][pos]
-                    env[(id(node), i)] = state_in[:sz].reshape(shp) \
+                    env[(id(node), i)] = b_in[:sz].reshape(shp) \
                         .astype(dt)
                 env, new_aux = _interpret(
                     seg_nodes, env, args, aux_vals, key,
@@ -517,70 +529,151 @@ class SymbolPipelineTrainStep:
                     node = plan["nodes"][pos]
                     y = env[(id(node), i)].astype(jnp.float32) \
                         .reshape(-1)
-                    state_out = jnp.zeros((maxB,), jnp.float32) \
+                    b_out = jnp.zeros((maxB,), jnp.float32) \
                         .at[:sz].set(y)
-                    # loss stays rank-1: jax 0.4.x shard_map partial-eval
-                    # assigns residuals a dim-0 mesh name, which a rank-0
-                    # residual cannot carry (_check_names _SpecError)
                     loss = jnp.zeros((1,), jnp.float32)
                 else:
+                    # last stage: loss only, the boundary out is a
+                    # CONSTANT zeros — the incoming cotangent seed has
+                    # no path through it, so garbage in the backward
+                    # channel can never reach the loss-head VJPs
                     loss = jnp.zeros((1,), jnp.float32)
                     for (pos, i) in out_entries:
                         node = plan["nodes"][pos]
                         loss = loss + jnp.sum(
                             env[(id(node), i)].astype(jnp.float32))
-                    state_out = jnp.zeros((maxB,), jnp.float32)
-                aux_out = local_aux
+                    b_out = jnp.zeros((maxB,), jnp.float32)
+                aux_out = aux_flat
                 for n, off, sz, shp in alayout:
                     aux_out = aux_out.at[off:off + sz].set(
                         new_aux[n].astype(jnp.float32).reshape(-1))
-                return state_out, aux_out, loss
+                return b_out, loss, aux_out
 
-            return branch
+            return stage_fwd
 
-        branches = [make_branch(s) for s in range(L)]
+        stage_fwds = [make_stage_fwd(s) for s in range(L)]
+        perm_f = [(i, i + 1) for i in range(L - 1)]
+        perm_b = [(i + 1, i) for i in range(L - 1)]
 
-        def stage_step(local_p, local_aux, state, t, data, tkey):
-            idx = lax.axis_index(axis)
-            return lax.switch(idx, branches, local_p, local_aux, state,
-                              t, data, tkey)
-
-        stage_step = jax.checkpoint(stage_step)
-        perm = [(i, i + 1) for i in range(L - 1)]
-
-        def pipeline_loss(flat_p, flat_aux, data, key):
+        def pipeline_grads(flat_p, flat_aux, data, key):
             idx = lax.axis_index(axis)
             local_p = jnp.squeeze(flat_p, 0)
-            local_aux = jnp.squeeze(flat_aux, 0)
-            state = jnp.zeros((maxB,), jnp.float32)
-            loss_sum = jnp.zeros((1,), jnp.float32)
-            if hasattr(lax, "pcast"):
-                state = lax.pcast(state, (axis,) + data_axes,
-                                  to="varying")
-                loss_sum = lax.pcast(loss_sum, (axis,) + data_axes,
-                                     to="varying")
+            local_aux0 = jnp.squeeze(flat_aux, 0)
+            op_tbl = jnp.asarray(op_np)
+            mb_tbl = jnp.asarray(mb_np)
+            arr_tbl = jnp.asarray(arr_np)
+
+            def mb_key(mbi, s):
+                # keyed by (microbatch, stage): the backward recompute
+                # and BOTH schedules fold in identical streams
+                return jax.random.fold_in(
+                    jax.random.fold_in(key, mbi), s)
+
+            zerosB = jnp.zeros((maxB,), jnp.float32)
+
+            def run_idle(mbi, slot, fwd_st, bwd_st, stash_b, stash_aux,
+                         aux_l, grad, losses):
+                return (zerosB, zerosB, stash_aux, aux_l, grad, losses)
+
+            def make_fwd(s):
+                f = stage_fwds[s]
+
+                def run(mbi, slot, fwd_st, bwd_st, stash_b, stash_aux,
+                        aux_l, grad, losses):
+                    mb = {k: v[mbi] for k, v in data.items()}
+                    b_out, loss, aux_out = f(
+                        local_p, stash_b[slot], mb, aux_l,
+                        mb_key(mbi, s))
+                    # bank the PRE-update aux: the backward recompute
+                    # must see what this forward saw
+                    stash_aux = stash_aux.at[slot].set(aux_l)
+                    losses = losses.at[mbi].add(loss[0])
+                    return (b_out, zerosB, stash_aux, aux_out, grad,
+                            losses)
+
+                return run
+
+            def make_bwd(s):
+                f = stage_fwds[s]
+
+                def run(mbi, slot, fwd_st, bwd_st, stash_b, stash_aux,
+                        aux_l, grad, losses):
+                    mb = {k: v[mbi] for k, v in data.items()}
+                    aux_in = stash_aux[slot]
+                    kk = mb_key(mbi, s)
+
+                    def f2(p, b):
+                        b_out, loss, _ = f(p, b, mb, aux_in, kk)
+                        return b_out, loss
+
+                    _, vjp = jax.vjp(f2, local_p, stash_b[slot])
+                    g_p, g_b = vjp((bwd_st,
+                                    jnp.ones((1,), jnp.float32)))
+                    grad = grad + g_p.astype(jnp.float32)
+                    return (zerosB, g_b.astype(jnp.float32), stash_aux,
+                            aux_l, grad, losses)
+
+                return run
+
+            fwd_brs = [make_fwd(s) for s in range(L)]
+            bwd_brs = [make_bwd(s) for s in range(L)]
+
+            def run_fwd(*a):
+                return lax.switch(idx, fwd_brs, *a)
+
+            def run_bwd(*a):
+                return lax.switch(idx, bwd_brs, *a)
 
             def tick(carry, t):
-                state, aux_l, loss_sum = carry
-                s_out, aux_new, loss = stage_step(
-                    local_p, aux_l, state, t, data,
-                    jax.random.fold_in(key, t))
-                real = ((t - idx >= 0) & (t - idx < M))
-                aux_l = jnp.where(real, aux_new, aux_l)
-                loss_sum = loss_sum + loss * real.astype(jnp.float32)
-                state = lax.ppermute(s_out, axis, perm)
-                return (state, aux_l, loss_sum), None
+                fwd_st, bwd_st, stash_b, stash_aux, aux_l, grad, \
+                    losses = carry
+                opc = op_tbl[t, idx]
+                mbi = mb_tbl[t, idx]
+                slot = jnp.mod(mbi, n_slots)
+                # bank the boundary hopping in this tick BEFORE the op
+                # (arrival can coincide with the consuming forward);
+                # row n_slots of the stash is scratch for no-arrival
+                stash_b = stash_b.at[arr_tbl[t, idx]].set(fwd_st)
+                fwd_st, bwd_st, stash_aux, aux_l, grad, losses = \
+                    lax.switch(opc, (run_idle, run_fwd, run_bwd),
+                               mbi, slot, fwd_st, bwd_st, stash_b,
+                               stash_aux, aux_l, grad, losses)
+                # activations hop downstream, cotangents hop upstream,
+                # every tick (idle ops send zeros nobody banks)
+                fwd_st = lax.ppermute(fwd_st, axis, perm_f)
+                bwd_st = lax.ppermute(bwd_st, axis, perm_b)
+                return (fwd_st, bwd_st, stash_b, stash_aux, aux_l,
+                        grad, losses), None
 
-            (state, aux_l, loss_sum), _ = lax.scan(
-                tick, (state, local_aux, loss_sum),
-                jnp.arange(M + L - 1))
-            total = lax.psum(loss_sum, (axis,) + data_axes)
+            carry = [zerosB, zerosB,
+                     jnp.zeros((n_slots + 1, maxB), jnp.float32),
+                     jnp.zeros((n_slots + 1, maxA), jnp.float32),
+                     local_aux0,
+                     jnp.zeros((maxP,), jnp.float32),
+                     jnp.zeros((M,), jnp.float32)]
+            if hasattr(lax, "pcast"):  # pragma: no cover - newer jax
+                # fresh zeros are unvarying; mark them device-varying
+                # so they are legal scan carries under shard_map
+                # (index 4, the aux row, derives from flat_aux and is
+                # already varying)
+                vary = (axis,) + data_axes
+                carry = [c if i == 4
+                         else lax.pcast(c, vary, to="varying")
+                         for i, c in enumerate(carry)]
+            carry, _ = lax.scan(tick, tuple(carry),
+                                jnp.arange(n_ticks))
+            _, _, _, _, aux_l, grad, losses = carry
+            # per-microbatch losses in microbatch order: only the last
+            # stage added non-zeros, dp shards each saw 1/ndp of every
+            # microbatch — psum over everything reassembles the batch
+            losses = lax.psum(losses, (axis,) + data_axes)
             if data_axes:
+                grad = lax.psum(grad, data_axes)
                 # BN-style aux updates come from LOCAL dp-shard stats
                 # (per-device BN, the reference's semantics); average
                 # them so the replicated-over-dp output is well-defined
                 aux_l = lax.pmean(aux_l, data_axes)
-            return total, aux_l[None]
+            return losses, aux_l[None], grad[None]
 
         P = jax.sharding.PartitionSpec
         data_spec = {n: P(None, data_axes if data_axes else None)
@@ -588,13 +681,13 @@ class SymbolPipelineTrainStep:
         shard_map = shard_map_fn()
         smap_kw = dict(mesh=self.mesh,
                        in_specs=(P(axis), P(axis), data_spec, P()),
-                       out_specs=(P(), P(axis)))
+                       out_specs=(P(), P(axis), P(axis)))
         try:
-            sharded_loss = shard_map(pipeline_loss, check_vma=False,
-                                     **smap_kw)
+            sharded_grads = shard_map(pipeline_grads, check_vma=False,
+                                      **smap_kw)
         except TypeError:  # pragma: no cover - older jax
-            sharded_loss = shard_map(pipeline_loss, check_rep=False,
-                                     **smap_kw)
+            sharded_grads = shard_map(pipeline_grads, check_rep=False,
+                                      **smap_kw)
 
         opt_op = get_op(self._opt_op)
         opt_attrs = dict(self._opt_attrs)
@@ -613,15 +706,9 @@ class SymbolPipelineTrainStep:
             if is_adam:
                 lr = lr * jnp.sqrt(1.0 - jnp.power(b2, t)) \
                     / (1.0 - jnp.power(b1, t))
-
-            def lossf(p):
-                # total comes back rank-1 (see the rank-0 residual note
-                # in pipeline_loss); take the scalar outside shard_map
-                total, aux = sharded_loss(p, flat_aux, data, key)
-                return total[0], aux
-
-            (loss, new_aux), g = jax.value_and_grad(
-                lossf, has_aux=True)(flat_p)
+            losses, new_aux, g = sharded_grads(flat_p, flat_aux, data,
+                                               key)
+            loss = jnp.sum(losses)
             g = g.astype(flat_p.dtype)
             p_in = flat_p
             if zero:
@@ -637,7 +724,8 @@ class SymbolPipelineTrainStep:
             new_p = res[0]
             if zero:
                 new_p = all_gather_constraint(new_p, self._stack_sh)
-            return new_p, tuple(res[1:1 + n_states]), new_aux, loss
+            return (new_p, tuple(res[1:1 + n_states]), new_aux, loss,
+                    losses)
 
         sh = self._stack_sh
         state_sh = tuple(self._state_sh for _ in range(n_states))
@@ -646,7 +734,7 @@ class SymbolPipelineTrainStep:
         return jax.jit(step,
                        in_shardings=(sh, state_sh, sh, None, None,
                                      data_sh, None),
-                       out_shardings=(sh, state_sh, sh, None),
+                       out_shardings=(sh, state_sh, sh, None, None),
                        donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------- call
@@ -667,7 +755,8 @@ class SymbolPipelineTrainStep:
             data[n] = jnp.asarray(v).reshape(
                 (M, v.shape[0] // M) + tuple(v.shape[1:]))
         self._key, key = jax.random.split(self._key)
-        self.flat_params, self.opt_states, self.flat_aux, loss = \
+        (self.flat_params, self.opt_states, self.flat_aux, loss,
+         self.microbatch_losses) = \
             self._step_fn(self.flat_params, self.opt_states,
                           self.flat_aux, jnp.float32(lr),
                           jnp.float32(self.num_update), data, key)
@@ -676,6 +765,49 @@ class SymbolPipelineTrainStep:
     # ------------------------------------------------------------ fence
     def sync(self) -> float:
         return float(np.asarray(self.flat_params[0, 0]))
+
+    # ----------------------------------------------------------- memory
+    def memory_analysis(self):
+        """``CompiledMemoryStats`` for the jitted train step, computed
+        AOT (jit → lower → compile on abstract shapes, no execution)
+        and cached.  ``temp_size_in_bytes`` is the per-device scratch
+        high-water mark — in-flight activations, stash buffers and XLA
+        workspace — the quantity the 1F1B schedule shrinks."""
+        if self._mem_stats is None:
+            import jax
+            import jax.numpy as jnp
+
+            L, M = self._L, self._M
+            maxP = self._plan["max_psize"]
+            maxA = self._plan["max_asize"]
+            f32 = jnp.float32
+            p = jax.ShapeDtypeStruct((L, maxP), f32)
+            states = tuple(jax.ShapeDtypeStruct((L, maxP), f32)
+                           for _ in range(self._n_states))
+            aux = jax.ShapeDtypeStruct((L, maxA), f32)
+            scalar = jax.ShapeDtypeStruct((), f32)
+            data = {n: jax.ShapeDtypeStruct(
+                        (M, self.global_batch // M)
+                        + tuple(self._micro_shapes[n][1:]), f32)
+                    for n in self.input_names}
+            key = jax.ShapeDtypeStruct(self._key.shape,
+                                       self._key.dtype)
+            self._mem_stats = self._step_fn.lower(
+                p, states, aux, scalar, scalar, data, key) \
+                .compile().memory_analysis()
+        return self._mem_stats
+
+    def peak_stage_bytes(self) -> int:
+        """Peak per-stage temp bytes of the compiled step (XLA buffer
+        assignment); publishes the ``pp_peak_stage_bytes`` gauge."""
+        stats = self.memory_analysis()
+        peak = int(getattr(stats, "temp_size_in_bytes", 0) or 0)
+        if telemetry.enabled():
+            telemetry.gauge(
+                "pp_peak_stage_bytes",
+                {"schedule": self.schedule,
+                 "scope": "pipeline"}).set(peak)
+        return peak
 
     # ------------------------------------------------------------ state
     def optimizer_state_bytes(self):

@@ -175,3 +175,86 @@ def test_conv_grad_finite_diff():
     fd = (l2 - l3) / (2 * eps)
     np.testing.assert_allclose(w_nd.grad.asnumpy()[0, 0, 0, 0], fd,
                                rtol=2e-2)
+
+
+def test_function_custom_backward():
+    """autograd.Function: the user backward replaces the op vjp
+    (reference python/mxnet/autograd.py:291 sigmoid example)."""
+
+    class sigmoid(ag.Function):
+        def forward(self, x):
+            y = 1 / (1 + mx.nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.nd.array(np.random.RandomState(0)
+                    .randn(3, 4).astype(np.float32))
+    x.attach_grad()
+    func = sigmoid()
+    with ag.record():
+        y = func(x)
+        loss = mx.nd.sum(y * y)
+    loss.backward()
+    sx = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), sx, rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               2 * sx * sx * (1 - sx), rtol=1e-5)
+
+
+def test_function_composes_with_taped_ops_and_grad():
+    """A Function node in the middle of a taped chain: gradients flow
+    through the custom backward, and ag.grad sees it too."""
+
+    class scale_by_three(ag.Function):
+        def forward(self, x):
+            return x * 3
+
+        def backward(self, dy):
+            return dy * 3
+
+    x = mx.nd.array([0.5, -1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        h = x * x           # taped op
+        f = scale_by_three()
+        y = f(h)            # custom node
+        loss = mx.nd.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6 * x.asnumpy(),
+                               rtol=1e-6)
+    x2 = mx.nd.array([0.5, -1.0, 2.0])
+    with ag.record():
+        loss2 = mx.nd.sum(scale_by_three()(x2 * x2))
+    g, = ag.grad(loss2, [x2])
+    np.testing.assert_allclose(g.asnumpy(), 6 * x2.asnumpy(), rtol=1e-6)
+
+
+def test_function_straight_through_and_reuse_rejected():
+    """The canonical use case the true derivative can't express: a
+    straight-through sign estimator.  Also: one record per instance."""
+    import pytest
+
+    from incubator_mxnet_tpu.base import MXNetError
+
+    class sign_st(ag.Function):
+        def forward(self, x):
+            return mx.nd.sign(x)
+
+        def backward(self, dy):
+            return dy  # straight-through: pretend d sign/dx = 1
+
+    x = mx.nd.array([-0.3, 0.0, 1.7])
+    x.attach_grad()
+    f = sign_st()
+    with ag.record():
+        y = f(x)
+        loss = mx.nd.sum(y * mx.nd.array([1.0, 2.0, 3.0]))
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0, 2.0, 3.0])
+    with ag.record():
+        with pytest.raises(MXNetError, match="single call"):
+            f(x)

@@ -336,6 +336,13 @@ def test_train_custom_op():
     assert "Train-accuracy" in out and "done" in out
 
 
+def test_train_autograd_function():
+    """autograd.Function in an imperative loop: straight-through sign
+    activation trains past chance (>0.7 asserted inside the driver)."""
+    out = _run("train_autograd_function.py", "--num-epochs", "8")
+    assert "Train-accuracy" in out and "done" in out
+
+
 def test_train_svm_mnist():
     """The svm_mnist family (reference example/svm_mnist): SVMOutput
     hinge heads — both L2 (squared hinge) and L1 (use_linear) — train

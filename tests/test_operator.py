@@ -1059,3 +1059,63 @@ EXTRA_COVERED = {
     "sample_poisson", "_sample_multinomial", "sample_multinomial",
     "_shuffle", "shuffle",
 }
+
+
+# ---------------------------------------------------------------------------
+# IdentityAttachKLSparseReg (identity_attach_KL_sparse_reg-inl.h)
+# ---------------------------------------------------------------------------
+
+
+def test_identity_attach_kl_sparse_reg_forward_and_aux():
+    rng = np.random.RandomState(4)
+    x = rng.uniform(0.05, 0.95, (6, 5)).astype(np.float32)
+    avg = np.full(5, 0.3, np.float32)
+    op = get_op("IdentityAttachKLSparseReg")
+    outs, aux = op.apply([jnp.asarray(x), jnp.asarray(avg)],
+                         {"momentum": "0.9"}, OpContext(is_train=True))
+    np.testing.assert_allclose(np.asarray(outs[0]), x)  # identity fwd
+    np.testing.assert_allclose(np.asarray(aux[0]),
+                               0.9 * avg + 0.1 * x.mean(0), rtol=1e-6)
+    # inference: identity, aux untouched
+    outs, aux = op.apply([jnp.asarray(x), jnp.asarray(avg)], {},
+                         OpContext(is_train=False))
+    np.testing.assert_allclose(np.asarray(outs[0]), x)
+    np.testing.assert_allclose(np.asarray(aux[0]), avg)
+
+
+def test_identity_attach_kl_sparse_reg_grad_finite_diff():
+    """With momentum=0 the attached term is the exact gradient of
+    J(x) = Σ(ct·x) + penalty · B · Σ_j KL(t ‖ colmean_j(x))."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd as ag
+
+    rng = np.random.RandomState(11)
+    B, H = 8, 4
+    t, penalty = 0.2, 0.05
+    x = rng.uniform(0.1, 0.9, (B, H)).astype(np.float32)
+    ct = rng.randn(B, H).astype(np.float32)
+
+    def objective(xv):
+        a = xv.mean(0)
+        kl = t * np.log(t / a) + (1 - t) * np.log((1 - t) / (1 - a))
+        return float((ct * xv).sum() + penalty * B * kl.sum())
+
+    x_nd = mx.nd.array(x)
+    x_nd.attach_grad()
+    avg_nd = mx.nd.array(np.full(H, 0.5, np.float32))
+    with ag.record():
+        out = mx.nd.IdentityAttachKLSparseReg(
+            x_nd, avg_nd, sparseness_target=t, penalty=penalty,
+            momentum=0.0)
+        loss = mx.nd.sum(out * mx.nd.array(ct))
+    loss.backward()
+    g = x_nd.grad.asnumpy()
+
+    eps = 1e-3
+    for i, j in [(0, 0), (3, 1), (7, 3)]:
+        xp, xm = x.astype(np.float64), x.astype(np.float64)
+        xp, xm = xp.copy(), xm.copy()
+        xp[i, j] += eps
+        xm[i, j] -= eps
+        fd = (objective(xp) - objective(xm)) / (2 * eps)
+        np.testing.assert_allclose(g[i, j], fd, rtol=2e-3, atol=1e-5)

@@ -879,6 +879,7 @@ NN_COVERED = {
 
 # ops exercised (numeric asserts) by other dedicated test files
 COVERED_ELSEWHERE = {
+    "IdentityAttachKLSparseReg": "test_operator.py",
     "Custom": "test_custom_op.py",
     "_contrib_DotProductAttention": "test_transformer.py",
     "DotProductAttention": "test_transformer.py",

@@ -1,0 +1,219 @@
+"""Pipeline schedule equivalence: 1F1B vs GPipe (ISSUE 3 tentpole).
+
+Both schedules run on the explicit tick-table engine
+(``pipeline.pp_schedule`` + ``SymbolPipelineTrainStep._build``), which
+accumulates per-stage gradients in increasing microbatch order and
+banks every backward's exact forward inputs — so 1F1B must be
+BIT-equal to GPipe: same loss sequence, same per-microbatch losses,
+same parameter bits, with and without ZeRO-1 state sharding.
+
+The memory side of the contract: at M = 4·pp the 1F1B compiled step
+must show a strictly lower per-device temp high-water mark than GPipe
+(min(L, M) stash slots + ≤ L−s in-flight microbatches vs all M),
+per XLA's buffer assignment (``memory_analysis``).
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import parallel
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.parallel import (SymbolPipelineTrainStep,
+                                          pp_bubble_fraction,
+                                          pp_schedule)
+
+PP = 4
+
+
+def _mlp(layers=4, hidden=16, classes=5):
+    x = mx.sym.Variable("data")
+    for i in range(layers):
+        x = mx.sym.FullyConnected(x, num_hidden=hidden, name="fc%d" % i)
+        x = mx.sym.Activation(x, act_type="relu", name="r%d" % i)
+    x = mx.sym.FullyConnected(x, num_hidden=classes, name="out")
+    return mx.sym.SoftmaxOutput(x, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def _batches(n, batch, feat=12, classes=5, seed=3):
+    rng = np.random.RandomState(seed)
+    return [{"data": rng.randn(batch, feat).astype(np.float32),
+             "softmax_label": rng.randint(0, classes, (batch,))
+             .astype(np.float32)} for _ in range(n)]
+
+
+def _run(schedule, M, mesh_axes, shard_optimizer=False,
+         optimizer="adam", steps=2):
+    mx.random.seed(17)
+    mesh = parallel.build_mesh(dict(mesh_axes))
+    ndp = 1
+    for a, n in mesh_axes.items():
+        if a != "pp":
+            ndp *= n
+    batch = 2 * M * ndp
+    step = SymbolPipelineTrainStep(
+        _mlp(), {"data": (batch, 12)}, {"softmax_label": (batch,)},
+        mesh=mesh, num_microbatches=M, optimizer=optimizer,
+        optimizer_params={"learning_rate": 0.01},
+        initializer=mx.initializer.Xavier(),
+        shard_optimizer=shard_optimizer, schedule=schedule)
+    losses = [step(b) for b in _batches(steps, batch)]
+    return (losses, np.asarray(step.microbatch_losses),
+            np.asarray(step.flat_params))
+
+
+# ---------------------------------------------------------------------------
+# bit-equality: loss sequence + per-microbatch losses + parameters
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("M", [PP, 2 * PP, 4 * PP],
+                         ids=["M=pp", "M=2pp", "M=4pp"])
+def test_1f1b_bit_equal_to_gpipe(M):
+    ref = _run("gpipe", M, {"pp": PP})
+    alt = _run("1f1b", M, {"pp": PP})
+    assert ref[0] == alt[0], "per-step loss sequence diverged"
+    np.testing.assert_array_equal(ref[1], alt[1],
+                                  err_msg="per-microbatch losses")
+    np.testing.assert_array_equal(ref[2], alt[2], err_msg="parameters")
+
+
+@pytest.mark.parametrize("M", [2, 4, 8], ids=["M=pp", "M=2pp", "M=4pp"])
+def test_1f1b_bit_equal_under_zero_sharding(M):
+    """dp2 x pp2 with ZeRO-1 optimizer-state sharding: the schedule
+    swap composes with the reduce-scatter/all-gather update path."""
+    ref = _run("gpipe", M, {"pp": 2, "dp": 4}, shard_optimizer=True)
+    alt = _run("1f1b", M, {"pp": 2, "dp": 4}, shard_optimizer=True)
+    assert ref[0] == alt[0]
+    np.testing.assert_array_equal(ref[1], alt[1])
+    np.testing.assert_array_equal(ref[2], alt[2])
+
+
+def test_microbatch_losses_in_order_and_sum():
+    """microbatch_losses come back in microbatch order and sum to the
+    returned loss, under both schedules."""
+    for sched in ("gpipe", "1f1b"):
+        mx.random.seed(17)
+        mesh = parallel.build_mesh({"pp": PP})
+        M, batch = 8, 16
+        step = SymbolPipelineTrainStep(
+            _mlp(), {"data": (batch, 12)},
+            {"softmax_label": (batch,)}, mesh=mesh,
+            num_microbatches=M, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(), schedule=sched)
+        loss = step(_batches(1, batch)[0])
+        mbl = np.asarray(step.microbatch_losses)
+        assert mbl.shape == (M,)
+        assert np.isfinite(mbl).all()
+        np.testing.assert_allclose(mbl.sum(), loss, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# memory: 1F1B holds O(L) activations, GPipe O(M)
+# ---------------------------------------------------------------------------
+
+
+def test_1f1b_peak_temp_bytes_below_gpipe_at_4pp_microbatches():
+    M = 4 * PP
+    peaks = {}
+    for sched in ("gpipe", "1f1b"):
+        mx.random.seed(17)
+        mesh = parallel.build_mesh({"pp": PP})
+        step = SymbolPipelineTrainStep(
+            _mlp(), {"data": (2 * M, 12)}, {"softmax_label": (2 * M,)},
+            mesh=mesh, num_microbatches=M, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(), schedule=sched)
+        peaks[sched] = step.peak_stage_bytes()
+    assert peaks["1f1b"] > 0
+    assert peaks["1f1b"] < peaks["gpipe"], peaks
+
+
+# ---------------------------------------------------------------------------
+# the schedule tables themselves (pure numpy — no mesh)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("L,M", [(2, 2), (4, 4), (4, 16), (8, 4),
+                                 (3, 7)])
+def test_schedule_tables_are_well_formed(schedule, L, M):
+    op, mb, arrive, n_slots = pp_schedule(schedule, L, M)
+    T = 2 * (M + L - 1)
+    assert op.shape == mb.shape == arrive.shape == (T, L)
+    fwd_ticks = {}
+    bwd_ticks = {}
+    for s in range(L):
+        f = [(t, mb[t, s]) for t in range(T) if op[t, s] == 1]
+        b = [(t, mb[t, s]) for t in range(T) if op[t, s] == 2]
+        # every microbatch exactly once per direction, backwards and
+        # forwards both issued in increasing microbatch order (the
+        # bit-equality invariant)
+        assert [m for _, m in f] == list(range(M))
+        assert [m for _, m in b] == list(range(M))
+        fwd_ticks[s] = dict((m, t) for t, m in f)
+        bwd_ticks[s] = dict((m, t) for t, m in b)
+    for s in range(L):
+        for m in range(M):
+            # a backward needs its forward first
+            assert fwd_ticks[s][m] < bwd_ticks[s][m]
+            if s > 0:
+                # the boundary hop takes exactly one tick
+                assert fwd_ticks[s][m] >= fwd_ticks[s - 1][m] + 1
+            if s < L - 1:
+                # the cotangent hop takes exactly one tick
+                assert bwd_ticks[s][m] >= bwd_ticks[s + 1][m] + 1
+
+
+def test_1f1b_in_flight_bound():
+    """1F1B holds at most L−s live microbatches at stage s; GPipe
+    peaks at M (the collection-buffer contrast the engine exploits)."""
+    L, M = 4, 16
+    for schedule, bound in (("1f1b", lambda s: L - s),
+                            ("gpipe", lambda s: M)):
+        op, mb, _, n_slots = pp_schedule(schedule, L, M)
+        for s in range(L):
+            live = peak = 0
+            for t in range(op.shape[0]):
+                if op[t, s] == 1:
+                    live += 1
+                elif op[t, s] == 2:
+                    live -= 1
+                peak = max(peak, live)
+            assert peak <= bound(s), (schedule, s, peak)
+        assert n_slots == (min(L, M) if schedule == "1f1b" else M)
+
+
+def test_bubble_fraction_and_gauges():
+    assert pp_bubble_fraction(1, 4) == 0.0
+    assert pp_bubble_fraction(4, 4) == pytest.approx(3 / 7)
+    mesh = parallel.build_mesh({"pp": 2})
+    step = SymbolPipelineTrainStep(
+        _mlp(2), {"data": (8, 12)}, {"softmax_label": (8,)},
+        mesh=mesh, num_microbatches=4, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1},
+        initializer=mx.initializer.Xavier(), schedule="1f1b")
+    assert step.bubble_fraction == pytest.approx(pp_bubble_fraction(2, 4))
+
+
+def test_unknown_schedule_rejected():
+    with pytest.raises(ValueError, match="schedule"):
+        pp_schedule("zb-h1", 2, 4)
+    mesh = parallel.build_mesh({"pp": 2})
+    with pytest.raises(MXNetError, match="schedule"):
+        SymbolPipelineTrainStep(
+            _mlp(2), {"data": (8, 12)}, {"softmax_label": (8,)},
+            mesh=mesh, num_microbatches=4,
+            initializer=mx.initializer.Xavier(), schedule="zb-h1")
+
+
+def test_env_var_selects_schedule(monkeypatch):
+    monkeypatch.setenv("TP_PP_SCHEDULE", "1f1b")
+    mesh = parallel.build_mesh({"pp": 2})
+    step = SymbolPipelineTrainStep(
+        _mlp(2), {"data": (8, 12)}, {"softmax_label": (8,)},
+        mesh=mesh, num_microbatches=4, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1},
+        initializer=mx.initializer.Xavier())
+    assert step.schedule == "1f1b"

@@ -14,7 +14,11 @@ Checks, in order:
    library package (use ``logging``; scripts/examples/tools are exempt),
    lines ≤ 100 chars in the package;
 3. **docs** — every relative ``.md`` link in ``docs/`` and README
-   resolves to a file.
+   resolves to a file;
+4. **schedule** — the fast 1F1B↔GPipe pipeline-schedule equivalence
+   subset (table invariants + one executed bit-equality case,
+   ``tests/test_pipeline_schedule.py``; needs jax — skip with
+   ``TP_CHECK_SCHEDULE=0``).
 
 Exit code 0 = clean; 1 = findings (printed one per line).
 """
@@ -111,11 +115,40 @@ def check_docs(problems):
                                 % (rel, target))
 
 
+def check_schedule(problems):
+    """1F1B vs GPipe equivalence gate (docs/pipeline.md): the pure
+    numpy tick-table invariants plus one executed bit-equality case on
+    the virtual CPU mesh — fast enough for every CI run."""
+    if os.environ.get("TP_CHECK_SCHEDULE", "1") == "0":
+        return
+    import subprocess
+
+    tests = "tests/test_pipeline_schedule.py"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q",
+             "-p", "no:cacheprovider", "-p", "no:randomly",
+             tests + "::test_schedule_tables_are_well_formed",
+             tests + "::test_1f1b_in_flight_bound",
+             tests + "::test_1f1b_bit_equal_to_gpipe[M=pp]"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        problems.append("schedule: equivalence run did not finish: %s" % e)
+        return
+    if proc.returncode != 0:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-12:]
+        problems.append("schedule: 1F1B/GPipe equivalence failed:\n  "
+                        + "\n  ".join(tail))
+
+
 def main():
     problems = []
     check_compile(problems)
     check_lint(problems)
     check_docs(problems)
+    check_schedule(problems)
     for p in problems:
         print(p)
     print("%d file(s) checked, %d problem(s)"
