@@ -79,7 +79,7 @@ _OPTIONAL = [
     ("test_utils", ()), ("parallel", ()), ("models", ()), ("gluon", ()),
     ("rnn", ()), ("image", ()), ("operator", ()), ("rtc", ()),
     ("contrib", ()), ("log", ()), ("libinfo", ()), ("torch", ()),
-    ("predictor", ()),
+    ("predictor", ()), ("serving", ()),
 ]
 
 import importlib as _importlib

@@ -29,13 +29,23 @@ class Predictor:
     """Fixed-shape inference runner over a loaded symbol + params."""
 
     def __init__(self, symbol, arg_params, aux_params,
-                 input_shapes: Dict[str, Sequence[int]]):
+                 input_shapes: Dict[str, Sequence[int]],
+                 input_dtypes: Optional[Dict[str, object]] = None):
         import jax
 
         from .lowering import lower_symbol
 
         self.symbol = symbol
         self._input_names = list(input_shapes.keys())
+        # per-input staging dtypes (``MXPredCreateEx`` analog): token-id
+        # inputs stay integral instead of round-tripping through f32
+        self._dtypes = {n: np.dtype(d)
+                        for n, d in (input_dtypes or {}).items()}
+        for n in self._dtypes:
+            if n not in input_shapes:
+                raise MXNetError("input_dtypes names %r which is not an "
+                                 "input (declared: %s)"
+                                 % (n, self._input_names))
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         for n in self._input_names:
@@ -56,8 +66,9 @@ class Predictor:
                     return jax.device_put(
                         np.zeros(shape, dtype=np.float32))
                 raise MXNetError("missing parameter %r" % (name,))
-            a = np.asarray(v.data if hasattr(v, "data") else v,
-                           dtype=np.float32)
+            a = np.asarray(v.data if hasattr(v, "data") else v)
+            if a.dtype == np.float64:
+                a = a.astype(np.float32)  # jax default-f32 convention
             if tuple(a.shape) != tuple(shape):
                 raise MXNetError(
                     "parameter %r has shape %s, expected %s"
@@ -90,7 +101,9 @@ class Predictor:
     # ------------------------------------------------------------ build
     @classmethod
     def load(cls, symbol_file: str, param_file: str,
-             input_shapes: Dict[str, Sequence[int]]) -> "Predictor":
+             input_shapes: Dict[str, Sequence[int]],
+             input_dtypes: Optional[Dict[str, object]] = None
+             ) -> "Predictor":
         """``MXPredCreate`` from the two-file checkpoint: symbol JSON +
         ``.params`` with ``arg:``/``aux:`` prefixed names (the format
         ``model.save_checkpoint`` and the reference both write)."""
@@ -110,7 +123,8 @@ class Predictor:
                 aux_params[k[4:]] = v
             else:  # bare names: accept as args (predict API did)
                 arg_params[k] = v
-        return cls(net, arg_params, aux_params, input_shapes)
+        return cls(net, arg_params, aux_params, input_shapes,
+                   input_dtypes=input_dtypes)
 
     # ------------------------------------------------------- C-API form
     def set_input(self, **inputs) -> None:
@@ -120,7 +134,7 @@ class Predictor:
                 raise MXNetError("unknown input %r (declared: %s)"
                                  % (n, self._input_names))
             a = np.asarray(v.data if hasattr(v, "data") else v,
-                           dtype=np.float32)
+                           dtype=self._dtypes.get(n, np.float32))
             if tuple(a.shape) != self._shapes[n]:
                 raise MXNetError("input %r has shape %s, expected %s"
                                  % (n, a.shape, self._shapes[n]))

@@ -1,0 +1,10 @@
+"""Serving subsystem: bucketed dynamic batching (:mod:`.engine`) and
+KV-cache continuous-batching generation (:mod:`.generate`).
+
+See docs/serving.md for the architecture and knob table."""
+from .engine import InferenceEngine, bucket_batch, bucket_length
+from .generate import (GenerationEngine, GenerationResult,
+                       KVTransformerLM, LMSpec)
+
+__all__ = ["InferenceEngine", "GenerationEngine", "GenerationResult",
+           "KVTransformerLM", "LMSpec", "bucket_batch", "bucket_length"]

@@ -1,0 +1,357 @@
+"""Thread-safe inference engine: request queue + bucketed dynamic batching.
+
+Not in the reference (v0.11 stops at the single-request C predict API,
+``src/c_api/c_predict_api.cc``); this is the Orca/Clipper-style serving
+layer the ROADMAP's "heavy traffic" north star needs.  Design contract
+with XLA: every launched program has a shape seen before or a shape from
+a SMALL closed set — requests are coalesced into **padded power-of-two
+batch buckets**, so a mixed-shape request stream compiles at most one
+program per (bucket, phase) instead of one per arrival pattern.
+
+- :class:`InferenceEngine` — generic batcher over any ``batch_fn`` that
+  maps a stacked input dict to a list of stacked outputs (axis 0 =
+  batch).  ``submit`` returns a ``concurrent.futures.Future``; a
+  background batcher thread groups compatible requests (same per-request
+  shape/dtype signature), pads the group to the next power of two, runs
+  the batch, and slices results back per request.
+- Admission control: a bounded queue (``TP_SERVE_MAX_QUEUE``) rejects
+  new work with ``MXNetError`` instead of building unbounded latency —
+  backpressure belongs at the edge, not in the queue.
+- Per-request deadlines: a request that waited past its deadline fails
+  fast with ``MXNetError`` and never occupies a device slot.
+
+Telemetry (``TP_TELEMETRY=1``): ``serve_queue_depth``,
+``serve_batch_size``, ``serve_padding_waste``,
+``serve_request_seconds``, ``serve_requests_total``,
+``serve_rejected_total``, ``serve_deadline_expired_total``,
+``serve_compiles_total{phase=...}``.  See docs/serving.md.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import telemetry
+from ..base import MXNetError, get_env
+
+__all__ = ["InferenceEngine", "bucket_batch", "bucket_length"]
+
+
+def bucket_batch(n: int, max_batch: int) -> int:
+    """Next power of two ≥ n, capped at ``max_batch`` (the batch-bucket
+    ladder: 1, 2, 4, ... — log2(max_batch)+1 compiled programs cover
+    every group size)."""
+    b = 1
+    while b < n and b < max_batch:
+        b <<= 1
+    return min(b, max_batch)
+
+
+def bucket_length(n: int, cap: Optional[int] = None) -> int:
+    """Next power of two ≥ n, optionally capped (the sequence-length
+    ladder for prompt prefill)."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, cap) if cap is not None else b
+
+
+class _Pending:
+    __slots__ = ("inputs", "future", "sig", "deadline", "t_submit")
+
+    def __init__(self, inputs, future, sig, deadline):
+        self.inputs = inputs
+        self.future = future
+        self.sig = sig
+        self.deadline = deadline
+        self.t_submit = time.perf_counter()
+
+
+class ServeStats:
+    """Host-side mirror of the serve telemetry (always on, so benches
+    and tests read it without enabling the global registry)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.requests = 0
+        self.rejected = 0
+        self.expired = 0
+        self.batches = 0
+        self.rows = 0
+        self.padded_rows = 0
+        self.compile_keys = set()
+
+    @property
+    def num_compiles(self) -> int:
+        return len(self.compile_keys)
+
+    @property
+    def padding_waste(self) -> float:
+        """Fraction of launched batch rows that were padding."""
+        return self.padded_rows / self.rows if self.rows else 0.0
+
+    def record_batch(self, key, n: int, bucket: int, phase: str) -> None:
+        with self.lock:
+            self.batches += 1
+            self.rows += bucket
+            self.padded_rows += bucket - n
+            fresh = key not in self.compile_keys
+            if fresh:
+                self.compile_keys.add(key)
+        if fresh:
+            telemetry.counter("serve_compiles_total",
+                              {"phase": phase}).inc()
+        telemetry.histogram("serve_batch_size").observe(n)
+        telemetry.histogram("serve_padding_waste").observe(
+            (bucket - n) / bucket)
+
+
+class InferenceEngine:
+    """Dynamic batcher over a stacked-batch forward function.
+
+    ``batch_fn(inputs)`` receives ``{name: np.ndarray}`` with a leading
+    batch axis (always a power-of-two bucket size) and returns a
+    sequence of stacked outputs.  Per-request inputs submitted to
+    :meth:`submit` carry NO batch axis; the engine stacks, pads (by
+    repeating the first row — real values, so no NaN poison), runs, and
+    slices row ``i`` of every output back to request ``i``.
+
+    Parameters
+    ----------
+    batch_fn : the compiled forward (e.g. a ``jax.jit`` that retraces
+        per shape — each bucket shape compiles once, which is the point)
+    max_batch : largest bucket (env ``TP_SERVE_MAX_BATCH``, default 32)
+    max_delay_ms : how long the batcher holds an incomplete bucket open
+        for more arrivals (env ``TP_SERVE_MAX_DELAY_MS``, default 2.0)
+    max_queue : admission bound; ``submit`` beyond it raises
+        ``MXNetError`` (env ``TP_SERVE_MAX_QUEUE``, default 256)
+    """
+
+    def __init__(self, batch_fn: Callable[[Dict[str, np.ndarray]],
+                                          Sequence[np.ndarray]],
+                 *, max_batch: Optional[int] = None,
+                 max_delay_ms: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 name: str = "serve"):
+        self._batch_fn = batch_fn
+        self.max_batch = int(max_batch if max_batch is not None
+                             else get_env("SERVE_MAX_BATCH", 32, int))
+        self.max_delay = float(
+            max_delay_ms if max_delay_ms is not None
+            else get_env("SERVE_MAX_DELAY_MS", 2.0, float)) / 1e3
+        self.max_queue = int(max_queue if max_queue is not None
+                             else get_env("SERVE_MAX_QUEUE", 256, int))
+        if self.max_batch < 1:
+            raise MXNetError("max_batch must be >= 1")
+        self.name = name
+        self.stats = ServeStats()
+        self._queue: List[_Pending] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._batcher_loop, name=name + "-batcher", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ client API
+    def submit(self, inputs: Dict[str, np.ndarray], *,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue one request; returns a Future resolving to the list
+        of per-request output arrays.  Raises ``MXNetError`` when the
+        queue is full (admission control) or the engine is closed."""
+        arrs = {n: np.asarray(v) for n, v in inputs.items()}
+        sig = tuple(sorted((n, a.shape, str(a.dtype))
+                           for n, a in arrs.items()))
+        fut: Future = Future()
+        deadline = (time.perf_counter() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        with self._cond:
+            if self._closed:
+                raise MXNetError("engine %r is closed" % self.name)
+            if len(self._queue) >= self.max_queue:
+                self.stats.rejected += 1
+                telemetry.counter("serve_rejected_total").inc()
+                raise MXNetError(
+                    "serve queue full (%d >= max_queue=%d): backpressure"
+                    % (len(self._queue), self.max_queue))
+            self._queue.append(_Pending(arrs, fut, sig, deadline))
+            telemetry.gauge("serve_queue_depth").set(len(self._queue))
+            self._cond.notify_all()
+        return fut
+
+    def predict(self, timeout: Optional[float] = 60.0,
+                **inputs) -> List[np.ndarray]:
+        """Synchronous convenience wrapper over :meth:`submit`."""
+        return self.submit(inputs).result(timeout=timeout)
+
+    def close(self) -> None:
+        """Stop the batcher; pending requests fail with ``MXNetError``."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            pending, self._queue = self._queue, []
+            self._cond.notify_all()
+        for p in pending:
+            p.future.set_exception(
+                MXNetError("engine %r closed" % self.name))
+        self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # --------------------------------------------------------- batcher thread
+    def _expire(self, now: float) -> None:
+        """Fail queued requests that outlived their deadline (must hold
+        the lock)."""
+        alive = []
+        for p in self._queue:
+            if p.deadline is not None and now > p.deadline:
+                self.stats.expired += 1
+                telemetry.counter("serve_deadline_expired_total").inc()
+                p.future.set_exception(MXNetError(
+                    "request deadline expired after %.1f ms in queue"
+                    % ((now - p.t_submit) * 1e3)))
+            else:
+                alive.append(p)
+        self._queue[:] = alive
+
+    def _take_group(self) -> Optional[List[_Pending]]:
+        """Pull the next same-signature group, holding an incomplete
+        bucket open up to ``max_delay`` past its oldest arrival.  Runs
+        inside the lock; returns None when closed and drained."""
+        while True:
+            if self._queue:
+                self._expire(time.perf_counter())
+            if not self._queue:
+                if self._closed:
+                    return None
+                self._cond.wait(timeout=0.1)
+                continue
+            head = self._queue[0]
+            group = [p for p in self._queue if p.sig == head.sig]
+            group = group[:self.max_batch]
+            flush_at = head.t_submit + self.max_delay
+            now = time.perf_counter()
+            if len(group) >= self.max_batch or now >= flush_at \
+                    or self._closed:
+                for p in group:
+                    self._queue.remove(p)
+                telemetry.gauge("serve_queue_depth").set(len(self._queue))
+                return group
+            self._cond.wait(timeout=flush_at - now)
+
+    def _batcher_loop(self) -> None:
+        while True:
+            with self._cond:
+                group = self._take_group()
+            if group is None:
+                return
+            self._run_group(group)
+
+    def _run_group(self, group: List[_Pending]) -> None:
+        n = len(group)
+        bucket = bucket_batch(n, self.max_batch)
+        names = list(group[0].inputs)
+        batch = {}
+        for name in names:
+            rows = [p.inputs[name] for p in group]
+            # pad to the bucket with copies of row 0: real values keep
+            # the padded rows numerically inert (no NaN/inf surprises
+            # feeding XLA), and they are sliced off before delivery
+            rows += [rows[0]] * (bucket - n)
+            batch[name] = np.stack(rows, axis=0)
+        key = ("forward", group[0].sig, bucket)
+        self.stats.record_batch(key, n, bucket, "forward")
+        t0 = time.perf_counter()
+        try:
+            outs = [np.asarray(o) for o in self._batch_fn(batch)]
+        except Exception as e:  # noqa: BLE001 — delivered per-future
+            for p in group:
+                p.future.set_exception(e)
+            return
+        now = time.perf_counter()
+        telemetry.histogram("serve_batch_seconds").observe(now - t0)
+        for i, p in enumerate(group):
+            self.stats.requests += 1
+            telemetry.counter("serve_requests_total").inc()
+            telemetry.histogram("serve_request_seconds").observe(
+                now - p.t_submit)
+            p.future.set_result([o[i] for o in outs])
+
+    # ------------------------------------------------------------- factories
+    @classmethod
+    def from_symbol(cls, symbol, arg_params, aux_params,
+                    input_shapes: Dict[str, Sequence[int]],
+                    input_dtypes: Optional[Dict] = None, **kw):
+        """Serve a loaded symbol+params pair (the Predictor pair) with
+        dynamic batching: ``input_shapes`` are PER-REQUEST shapes (no
+        batch axis); the jitted forward retraces per batch bucket, so a
+        mixed-load stream compiles once per bucket."""
+        import jax
+
+        from ..lowering import lower_symbol
+
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        for n in input_shapes:
+            if n not in arg_names:
+                raise MXNetError("input %r is not an argument of the "
+                                 "symbol" % (n,))
+        probe = {n: (1,) + tuple(s) for n, s in input_shapes.items()}
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**probe)
+        shape_of = dict(zip(arg_names, arg_shapes))
+        dtypes = dict(input_dtypes or {})
+
+        def park(src, name, shape):
+            v = (src or {}).get(name)
+            if v is None:
+                if "label" in name:
+                    return None  # rebuilt per batch bucket
+                raise MXNetError("missing parameter %r" % (name,))
+            a = np.asarray(v.data if hasattr(v, "data") else v)
+            if a.dtype == np.float64:
+                a = a.astype(np.float32)
+            return jax.device_put(a)
+
+        params = {n: park(arg_params, n, shape_of[n])
+                  for n in arg_names if n not in input_shapes}
+        aux = {n: park(aux_params, n, s)
+               for n, s in zip(aux_names, aux_shapes)}
+        label_names = [n for n, v in params.items() if v is None]
+        label_shape = {n: tuple(shape_of[n][1:]) for n in label_names}
+        for n in label_names:
+            del params[n]
+
+        fwd = lower_symbol(symbol, is_train=False)
+        key = jax.random.PRNGKey(0)
+
+        @jax.jit
+        def run(inputs):
+            import jax.numpy as jnp
+
+            args = dict(params)
+            args.update(inputs)
+            b = next(iter(inputs.values())).shape[0]
+            for n in label_names:
+                # loss-head labels are dead at inference; bind zeros of
+                # the bucket's batch shape (C predict API convention)
+                args[n] = jnp.zeros((b,) + label_shape[n], jnp.float32)
+            outs, _ = fwd(args, aux, key)
+            return outs
+
+        def batch_fn(batch):
+            staged = {}
+            for n, a in batch.items():
+                want = np.dtype(dtypes.get(n, np.float32))
+                staged[n] = np.ascontiguousarray(a, dtype=want)
+            return run(staged)
+
+        return cls(batch_fn, **kw)

@@ -1,0 +1,762 @@
+"""Autoregressive generation for the transformer LM: fixed-shape KV
+cache + slot-based continuous batching.
+
+The training-side symbol (``models/transformer.py``) is shape-static by
+the XLA contract, so naive generation would recompile per sequence
+length.  This module keeps the SERVING side shape-static too, vLLM/Orca
+style, with exactly two program families:
+
+- **prefill** — one compiled program per (batch-bucket, length-bucket):
+  runs the prompt through the stack with causal attention, writes K/V
+  into the requests' cache slots, and returns the last-position logits
+  (which sample the FIRST new token — TTFT ends here).  Prompts pad to
+  a power-of-two length bucket; the padded K/V rows sit beyond the
+  prompt length and are never attended (the decode mask is
+  ``position <= length``), then get overwritten token by token as
+  decode advances — which is also why slot recycling needs no cache
+  reset.
+- **decode** — ONE compiled program, ever: a single-token step over the
+  full slot batch.  Per-slot ``lengths`` drive both the attention mask
+  and the scatter position, so sequences of different ages share the
+  program.  Finished sequences free their slot and queued prompts join
+  the running batch without recompiling — continuous batching.
+
+Numerics match the training graph op-for-op (LayerNorm f32 two-pass
+stats, FullyConnected ``x·Wᵀ+b``, max-subtract softmax attention):
+``tests/test_serving.py`` asserts decode logits equal the full-sequence
+symbol forward within 1e-5.  Sampling reuses the registered ops —
+``ops/ordering.py`` ``topk`` and ``_sample_multinomial`` — under greedy
+/ temperature / top-k policies.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..base import MXNetError, get_env
+from .engine import ServeStats, bucket_batch, bucket_length
+
+__all__ = ["LMSpec", "KVTransformerLM", "GenerationEngine",
+           "GenerationResult"]
+
+
+class LMSpec:
+    """Architecture of a ``models.transformer_lm`` checkpoint, inferred
+    from parameter shapes (heads cannot be inferred — pass it)."""
+
+    __slots__ = ("vocab_size", "embed", "heads", "num_layers", "max_seq",
+                 "fused_qkv", "head_bias")
+
+    def __init__(self, vocab_size, embed, heads, num_layers, max_seq,
+                 fused_qkv=False, head_bias=True):
+        if embed % heads:
+            raise MXNetError("embed (%d) must divide by heads (%d)"
+                             % (embed, heads))
+        self.vocab_size = vocab_size
+        self.embed = embed
+        self.heads = heads
+        self.num_layers = num_layers
+        self.max_seq = max_seq
+        self.fused_qkv = fused_qkv
+        self.head_bias = head_bias
+
+    @property
+    def head_dim(self):
+        return self.embed // self.heads
+
+    @classmethod
+    def from_params(cls, params: Dict[str, np.ndarray],
+                    heads: int) -> "LMSpec":
+        def shape(name):
+            v = params.get(name)
+            if v is None:
+                raise MXNetError(
+                    "parameter %r missing: not a transformer_lm "
+                    "checkpoint (have %s...)" % (name,
+                                                 sorted(params)[:6]))
+            return tuple(np.asarray(
+                v.data if hasattr(v, "data") else v).shape)
+
+        if any("_moe_" in n for n in params):
+            raise MXNetError("serving supports the dense-FFN transformer "
+                             "family; MoE generation is not implemented")
+        vocab, embed = shape("tok_embed_weight")
+        max_seq = shape("pos_embed_weight")[0]
+        layers = 0
+        while ("block%d_ln1_gamma" % layers) in params:
+            layers += 1
+        if not layers:
+            raise MXNetError("no transformer blocks found in params")
+        fused_qkv = "block0_qkv_weight" in params
+        head_bias = "lm_head_bias" in params
+        return cls(vocab, embed, heads, layers, max_seq,
+                   fused_qkv=fused_qkv, head_bias=head_bias)
+
+
+def _ln(x, gamma, beta, eps=1e-5):
+    import jax
+    import jax.numpy as jnp
+
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+
+
+def _fc(x, w, b=None):
+    import jax.numpy as jnp
+
+    y = jnp.matmul(x, w.T)
+    return y if b is None else y + b
+
+
+class KVTransformerLM:
+    """Pure-jax twin of the ``models/transformer.py`` forward with a
+    fixed-shape KV cache, built from a trained ``arg_params`` dict.
+
+    The cache is a pair of ``(slots, layers, heads, max_len, head_dim)``
+    arrays threaded functionally through the compiled steps (donated
+    back by the engine).  Per-shape program bookkeeping lives in
+    ``self.stats`` so callers can assert the compile bound.
+    """
+
+    def __init__(self, arg_params: Dict, heads: int,
+                 spec: Optional[LMSpec] = None):
+        import jax
+
+        self.spec = spec or LMSpec.from_params(arg_params, heads)
+        self.params = {}
+        for n, v in arg_params.items():
+            a = np.asarray(v.data if hasattr(v, "data") else v)
+            if a.dtype != np.float32:
+                a = a.astype(np.float32)
+            self.params[n] = jax.device_put(a)
+        self.stats = ServeStats()
+        self._prefill_fns = {}
+        self._decode_fn = None
+        self._sample_fns = {}
+
+    # ----------------------------------------------------------- cache setup
+    def init_cache(self, num_slots: int, max_len: int):
+        """Allocate the fixed-shape cache: one scratch slot is appended
+        at index ``num_slots`` so padded prefill rows have a harmless
+        scatter target."""
+        import jax.numpy as jnp
+
+        s = self.spec
+        if max_len > s.max_seq:
+            raise MXNetError(
+                "max_len %d exceeds the model's position table (%d)"
+                % (max_len, s.max_seq))
+        shape = (num_slots + 1, s.num_layers, s.heads, max_len,
+                 s.head_dim)
+        return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+
+    # ------------------------------------------------------------- internals
+    def _embed(self, tokens, positions):
+        import jax.numpy as jnp
+
+        p = self.params
+        tok = jnp.take(p["tok_embed_weight"], tokens, axis=0)
+        pos = jnp.take(p["pos_embed_weight"], positions, axis=0)
+        return tok + pos
+
+    def _qkv(self, i, h):
+        """Project ``h`` (..., E) to per-head q, k, v (..., H, D)."""
+        import jax.numpy as jnp
+
+        p, s = self.params, self.spec
+        E = s.embed
+        if s.fused_qkv:
+            p3 = _fc(h, p["block%d_qkv_weight" % i])
+            parts = [p3[..., j * E:(j + 1) * E] for j in range(3)]
+        else:
+            parts = [_fc(h, p["block%d_%s_weight" % (i, w)])
+                     for w in ("q", "k", "v")]
+        return [jnp.reshape(a, a.shape[:-1] + (s.heads, s.head_dim))
+                for a in parts]
+
+    def _ffn(self, i, x):
+        import jax
+
+        p = self.params
+        h = _ln(x, p["block%d_ln2_gamma" % i], p["block%d_ln2_beta" % i])
+        h = jax.nn.relu(_fc(h, p["block%d_ffn1_weight" % i],
+                            p["block%d_ffn1_bias" % i]))
+        return x + _fc(h, p["block%d_ffn2_weight" % i],
+                       p["block%d_ffn2_bias" % i])
+
+    def _head(self, x):
+        p = self.params
+        return _fc(x, p["lm_head_weight"],
+                   p.get("lm_head_bias") if self.spec.head_bias else None)
+
+    def _attn_out(self, i, att, x):
+        """Merge heads, project, add residual.  ``att`` (..., H, D)."""
+        import jax.numpy as jnp
+
+        p, s = self.params, self.spec
+        merged = jnp.reshape(att, att.shape[:-2] + (s.embed,))
+        return x + _fc(merged, p["block%d_attn_proj_weight" % i],
+                       p["block%d_attn_proj_bias" % i])
+
+    # --------------------------------------------------------------- prefill
+    def _build_prefill(self):
+        import jax
+        import jax.numpy as jnp
+
+        s = self.spec
+        scale = 1.0 / s.head_dim ** 0.5
+        neg = jnp.finfo(jnp.float32).min
+
+        def prefill(cache_k, cache_v, tokens, lengths, slots):
+            # tokens (N, L) int32; lengths/slots (N,) int32
+            N, L = tokens.shape
+            x = self._embed(tokens, jnp.arange(L)[None, :])  # (N, L, E)
+            causal = (jnp.arange(L)[:, None] >= jnp.arange(L)[None, :])
+            ks, vs = [], []
+            for i in range(s.num_layers):
+                h = _ln(x, self.params["block%d_ln1_gamma" % i],
+                        self.params["block%d_ln1_beta" % i])
+                q, k, v = self._qkv(i, h)          # (N, L, H, D)
+                q = jnp.moveaxis(q, 1, 2)          # (N, H, L, D)
+                k = jnp.moveaxis(k, 1, 2)
+                v = jnp.moveaxis(v, 1, 2)
+                sc = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
+                sc = jnp.where(causal, sc, neg)
+                w = jax.nn.softmax(sc, axis=-1)
+                att = jnp.einsum("nhqk,nhkd->nhqd", w, v)
+                att = jnp.moveaxis(att, 1, 2)      # (N, L, H, D)
+                x = self._attn_out(i, att, x)
+                x = self._ffn(i, x)
+                ks.append(k)
+                vs.append(v)
+            # one scatter per cache: (N, layers, H, L, D) into the slot
+            # rows' first L positions
+            knew = jnp.stack(ks, axis=1)
+            vnew = jnp.stack(vs, axis=1)
+            cache_k = cache_k.at[slots, :, :, :L, :].set(knew)
+            cache_v = cache_v.at[slots, :, :, :L, :].set(vnew)
+            x = _ln(x, self.params["ln_f_gamma"],
+                    self.params["ln_f_beta"])
+            last = jnp.take_along_axis(
+                x, (lengths - 1)[:, None, None], axis=1)[:, 0]  # (N, E)
+            return cache_k, cache_v, self._head(last)
+
+        return prefill
+
+    def prefill(self, cache_k, cache_v, tokens: np.ndarray,
+                lengths: np.ndarray, slots: np.ndarray):
+        """Run one padded prompt bucket.  ``tokens`` (N, L) with N and L
+        already bucketed; returns (cache_k, cache_v, last_logits)."""
+        import jax
+        import jax.numpy as jnp
+
+        N, L = tokens.shape
+        fn = self._prefill_fns.get((N, L))
+        if fn is None:
+            fn = jax.jit(self._build_prefill())
+            self._prefill_fns[(N, L)] = fn
+        self.stats.record_batch(("prefill", N, L),
+                                int((np.asarray(lengths) > 0).sum()), N,
+                                "prefill")
+        # jnp.array (not asarray): jax on CPU may alias numpy buffers
+        # zero-copy, and dispatch is async — a caller mutating its
+        # lengths/tokens array after this call would race the compute.
+        return fn(cache_k, cache_v,
+                  jnp.array(tokens, jnp.int32),
+                  jnp.array(lengths, jnp.int32),
+                  jnp.array(slots, jnp.int32))
+
+    # ---------------------------------------------------------------- decode
+    def _build_decode(self):
+        import jax
+        import jax.numpy as jnp
+
+        s = self.spec
+        scale = 1.0 / s.head_dim ** 0.5
+        neg = jnp.finfo(jnp.float32).min
+
+        def decode(cache_k, cache_v, tokens, lengths):
+            # tokens/lengths (slots,) int32: the new token per slot sits
+            # at position `lengths` and attends to cached j < lengths
+            # plus itself — softmax over the concat matches a full
+            # causal row bit-for-bit in f32 tolerance.
+            nslots = tokens.shape[0]
+            S = cache_k.shape[3]
+            x = self._embed(tokens, lengths)               # (slots, E)
+            mask = (jnp.arange(S)[None, :]
+                    < lengths[:, None])[:, None, :]        # (slots,1,S)
+            ks, vs = [], []
+            for i in range(s.num_layers):
+                h = _ln(x, self.params["block%d_ln1_gamma" % i],
+                        self.params["block%d_ln1_beta" % i])
+                q, k, v = self._qkv(i, h)                  # (slots, H, D)
+                kc = cache_k[:nslots, i]                   # (slots,H,S,D)
+                vc = cache_v[:nslots, i]
+                sc = jnp.einsum("nhd,nhkd->nhk", q, kc) * scale
+                sc = jnp.where(mask, sc, neg)
+                s_self = jnp.einsum("nhd,nhd->nh", q, k) * scale
+                full = jnp.concatenate([sc, s_self[..., None]], axis=-1)
+                w = jax.nn.softmax(full, axis=-1)
+                att = jnp.einsum("nhk,nhkd->nhd", w[..., :S], vc) \
+                    + w[..., S, None] * v
+                x = self._attn_out(i, att, x)
+                x = self._ffn(i, x)
+                ks.append(k)
+                vs.append(v)
+            knew = jnp.stack(ks, axis=1)        # (slots, layers, H, D)
+            vnew = jnp.stack(vs, axis=1)
+            rows = jnp.arange(nslots)
+            pos = jnp.minimum(lengths, S - 1)
+            cache_k = cache_k.at[rows, :, :, pos, :].set(knew)
+            cache_v = cache_v.at[rows, :, :, pos, :].set(vnew)
+            x = _ln(x, self.params["ln_f_gamma"],
+                    self.params["ln_f_beta"])
+            return cache_k, cache_v, self._head(x)
+
+        return decode
+
+    def decode(self, cache_k, cache_v, tokens: np.ndarray,
+               lengths: np.ndarray):
+        """One single-token step over the full slot batch (the ONE
+        compiled decode program)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._decode_fn is None:
+            self._decode_fn = jax.jit(self._build_decode())
+        n = int(np.asarray(tokens).shape[0])
+        self.stats.record_batch(("decode", n), n, n, "decode")
+        # forced copy: see prefill() — callers mutate lengths in place
+        # between steps and CPU jax may alias numpy buffers zero-copy
+        return self._decode_fn(cache_k, cache_v,
+                               jnp.array(tokens, jnp.int32),
+                               jnp.array(lengths, jnp.int32))
+
+    # --------------------------------------------------------------- oracles
+    def full_logits(self, tokens: np.ndarray) -> np.ndarray:
+        """Full-sequence forward (no cache): the parity oracle.  Returns
+        (B, L, vocab) logits."""
+        import jax
+        import jax.numpy as jnp
+
+        tokens = np.asarray(tokens, np.int32)
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        B, L = tokens.shape
+        key = (B, L, "full")
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            fn = jax.jit(lambda t: _all_logits(self, t))
+            self._prefill_fns[key] = fn
+        return np.asarray(fn(jnp.asarray(tokens, jnp.int32)))
+
+    # -------------------------------------------------------------- sampling
+    def sample(self, logits, key, temperature: float = 0.0,
+               top_k: int = 0):
+        """Sample next tokens from (n, vocab) logits.  ``temperature<=0``
+        is greedy argmax; otherwise softmax sampling through the
+        registered ``_sample_multinomial`` op, optionally truncated to
+        the ``topk`` op's top-k candidates."""
+        import jax
+
+        cfg = (float(temperature), int(top_k),
+               tuple(np.asarray(logits).shape))
+        fn = self._sample_fns.get(cfg)
+        if fn is None:
+            fn = jax.jit(_build_sample(float(temperature), int(top_k)))
+            self._sample_fns[cfg] = fn
+            with self.stats.lock:
+                self.stats.compile_keys.add(("sample",) + cfg)
+            telemetry.counter("serve_compiles_total",
+                              {"phase": "sample"}).inc()
+        return np.asarray(fn(logits, key)).astype(np.int32)
+
+
+def _all_logits(model: KVTransformerLM, tokens):
+    """Trace the full causal forward, returning logits at EVERY
+    position (the test/bench oracle; same math as prefill)."""
+    import jax
+    import jax.numpy as jnp
+
+    s = model.spec
+    scale = 1.0 / s.head_dim ** 0.5
+    neg = jnp.finfo(jnp.float32).min
+    B, L = tokens.shape
+    x = model._embed(tokens, jnp.arange(L)[None, :])
+    causal = (jnp.arange(L)[:, None] >= jnp.arange(L)[None, :])
+    for i in range(s.num_layers):
+        h = _ln(x, model.params["block%d_ln1_gamma" % i],
+                model.params["block%d_ln1_beta" % i])
+        q, k, v = model._qkv(i, h)
+        q = jnp.moveaxis(q, 1, 2)
+        k = jnp.moveaxis(k, 1, 2)
+        v = jnp.moveaxis(v, 1, 2)
+        sc = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
+        sc = jnp.where(causal, sc, neg)
+        w = jax.nn.softmax(sc, axis=-1)
+        att = jnp.moveaxis(jnp.einsum("nhqk,nhkd->nhqd", w, v), 1, 2)
+        x = model._attn_out(i, att, x)
+        x = model._ffn(i, x)
+    x = _ln(x, model.params["ln_f_gamma"], model.params["ln_f_beta"])
+    return model._head(x)
+
+
+def _build_sample(temperature: float, top_k: int):
+    """Sampling kernel over (n, vocab) logits reusing the registered
+    ordering/random ops (ISSUE contract: one source of truth for topk
+    and multinomial semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.registry import OpContext, get_op
+
+    topk_op = get_op("topk")
+    multinomial = get_op("_sample_multinomial")
+
+    def fn(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / temperature
+        if top_k:
+            outs, _ = topk_op.apply(
+                [scaled], {"k": str(top_k), "ret_typ": "both",
+                           "axis": "-1"}, OpContext())
+            vals, idx = outs
+            probs = jax.nn.softmax(vals, axis=-1)
+            picked, _ = multinomial.apply(
+                [probs], {}, OpContext(rng=key))
+            pick = picked[0].astype(jnp.int32)
+            return jnp.take_along_axis(
+                idx.astype(jnp.int32), pick[:, None], axis=-1)[:, 0]
+        probs = jax.nn.softmax(scaled, axis=-1)
+        picked, _ = multinomial.apply([probs], {}, OpContext(rng=key))
+        return picked[0].astype(jnp.int32)
+
+    return fn
+
+
+class GenerationResult:
+    """Outcome of one generation request."""
+
+    __slots__ = ("tokens", "logits", "prompt_len", "slot", "ttft_s")
+
+    def __init__(self, tokens, logits, prompt_len, slot, ttft_s):
+        self.tokens = tokens          # (n_generated,) int32
+        self.logits = logits          # (n_generated, vocab) f32 or None
+        self.prompt_len = prompt_len
+        self.slot = slot
+        self.ttft_s = ttft_s
+
+
+class _GenPending:
+    __slots__ = ("tokens", "max_new", "temperature", "top_k",
+                 "stop_token", "return_logits", "deadline", "t_submit",
+                 "future")
+
+    def __init__(self, tokens, max_new, temperature, top_k, stop_token,
+                 return_logits, deadline, future):
+        self.tokens = tokens
+        self.max_new = max_new
+        self.temperature = temperature
+        self.top_k = top_k
+        self.stop_token = stop_token
+        self.return_logits = return_logits
+        self.deadline = deadline
+        self.t_submit = time.perf_counter()
+        self.future = future
+
+
+class _Seq:
+    """One running sequence occupying a cache slot."""
+
+    __slots__ = ("req", "slot", "length", "last_token", "generated",
+                 "logits", "t_first", "t_last")
+
+    def __init__(self, req, slot, prompt_len):
+        self.req = req
+        self.slot = slot
+        self.length = prompt_len     # tokens with K/V in cache... + self
+        self.last_token = None       # newest sampled token (no K/V yet)
+        self.generated: List[int] = []
+        self.logits: List[np.ndarray] = []
+        self.t_first = None
+        self.t_last = None
+
+    @property
+    def done(self):
+        if len(self.generated) >= self.req.max_new:
+            return True
+        return (self.req.stop_token is not None and self.generated
+                and self.generated[-1] == self.req.stop_token)
+
+
+class GenerationEngine:
+    """Continuous-batching generation server over a
+    :class:`KVTransformerLM`.
+
+    ``submit`` enqueues a prompt and returns a Future resolving to a
+    :class:`GenerationResult`.  A background loop interleaves (a)
+    admitting queued prompts into free cache slots via bucketed prefill
+    and (b) single-token decode steps over every running slot — new
+    arrivals join the running batch between steps, finished sequences
+    free their slot immediately (Orca iteration-level scheduling).
+    """
+
+    def __init__(self, model: KVTransformerLM, *,
+                 max_slots: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 seed: int = 0, name: str = "serve_lm"):
+        import jax
+
+        self.model = model
+        self.max_slots = int(max_slots if max_slots is not None
+                             else get_env("SERVE_SLOTS", 8, int))
+        self.max_len = int(max_len if max_len is not None
+                           else model.spec.max_seq)
+        self.max_queue = int(max_queue if max_queue is not None
+                             else get_env("SERVE_MAX_QUEUE", 256, int))
+        self.name = name
+        self.stats = model.stats
+        self._cache_k, self._cache_v = model.init_cache(
+            self.max_slots, self.max_len)
+        self._seqs: List[Optional[_Seq]] = [None] * self.max_slots
+        self._lengths = np.zeros(self.max_slots, np.int32)
+        self._pending: List[_GenPending] = []
+        self._key = jax.random.PRNGKey(seed)
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name=name + "-decode", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ client API
+    def submit(self, tokens, max_new_tokens: int = 16, *,
+               temperature: float = 0.0, top_k: int = 0,
+               stop_token: Optional[int] = None,
+               return_logits: bool = False,
+               deadline_ms: Optional[float] = None) -> Future:
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size < 1:
+            raise MXNetError("empty prompt")
+        if tokens.size + max_new_tokens > self.max_len:
+            raise MXNetError(
+                "prompt (%d) + max_new_tokens (%d) exceeds the engine's "
+                "max_len (%d)" % (tokens.size, max_new_tokens,
+                                  self.max_len))
+        fut: Future = Future()
+        deadline = (time.perf_counter() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        req = _GenPending(tokens, int(max_new_tokens), temperature,
+                          int(top_k), stop_token, return_logits,
+                          deadline, fut)
+        with self._cond:
+            if self._closed:
+                raise MXNetError("engine %r is closed" % self.name)
+            if len(self._pending) >= self.max_queue:
+                self.stats.rejected += 1
+                telemetry.counter("serve_rejected_total").inc()
+                raise MXNetError(
+                    "serve queue full (%d >= max_queue=%d): backpressure"
+                    % (len(self._pending), self.max_queue))
+            self._pending.append(req)
+            telemetry.gauge("serve_queue_depth").set(len(self._pending))
+            self._cond.notify_all()
+        return fut
+
+    def generate(self, tokens, max_new_tokens: int = 16,
+                 timeout: Optional[float] = 120.0,
+                 **kw) -> GenerationResult:
+        """Synchronous convenience wrapper over :meth:`submit`."""
+        return self.submit(tokens, max_new_tokens, **kw).result(
+            timeout=timeout)
+
+    def close(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            pending, self._pending = self._pending, []
+            self._cond.notify_all()
+        for p in pending:
+            p.future.set_exception(
+                MXNetError("engine %r closed" % self.name))
+        self._thread.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for s in self._seqs if s is not None)
+
+    # ------------------------------------------------------------- the loop
+    def _next_key(self):
+        import jax
+
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _expire_pending(self, now: float) -> None:
+        alive = []
+        for p in self._pending:
+            if p.deadline is not None and now > p.deadline:
+                self.stats.expired += 1
+                telemetry.counter("serve_deadline_expired_total").inc()
+                p.future.set_exception(MXNetError(
+                    "request deadline expired after %.1f ms in queue"
+                    % ((now - p.t_submit) * 1e3)))
+            else:
+                alive.append(p)
+        self._pending[:] = alive
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                self._expire_pending(time.perf_counter())
+                has_work = (self._pending
+                            and self.active_slots < self.max_slots) \
+                    or self.active_slots > 0
+                if not has_work:
+                    if self._closed:
+                        if self.active_slots == 0:
+                            return
+                    else:
+                        self._cond.wait(timeout=0.1)
+                        continue
+                admitted = self._take_admissible()
+            try:
+                if admitted:
+                    self._admit(admitted)
+                if self.active_slots:
+                    self._decode_step()
+            except Exception as e:  # noqa: BLE001 — fail the sequences
+                self._fail_all(e)
+
+    def _take_admissible(self) -> List[_GenPending]:
+        """Pull as many pending requests as there are free slots (must
+        hold the lock)."""
+        free = self.max_slots - self.active_slots
+        take, self._pending = (self._pending[:free],
+                               self._pending[free:])
+        telemetry.gauge("serve_queue_depth").set(len(self._pending))
+        return take
+
+    def _fail_all(self, exc: Exception) -> None:
+        for i, seq in enumerate(self._seqs):
+            if seq is not None:
+                seq.req.future.set_exception(exc)
+                self._seqs[i] = None
+                self._lengths[i] = 0
+
+    # -------------------------------------------------------------- admit
+    def _admit(self, reqs: List[_GenPending]) -> None:
+        """Prefill newcomers into free slots, bucketed by prompt-length
+        then batch power of two; sample their first token (TTFT)."""
+        free = [i for i, s in enumerate(self._seqs) if s is None]
+        groups: Dict[int, List[_GenPending]] = {}
+        for r in reqs:
+            L = bucket_length(r.tokens.size, self.max_len)
+            groups.setdefault(L, []).append(r)
+        for L, group in sorted(groups.items()):
+            while group:
+                chunk = group[:len(free)]
+                group = group[len(free):]
+                n = len(chunk)
+                nb = bucket_batch(n, self.max_slots)
+                toks = np.zeros((nb, L), np.int32)
+                lens = np.ones(nb, np.int32)
+                # padding rows target the scratch slot (index
+                # max_slots) so their K/V writes land nowhere real
+                slots = np.full(nb, self.max_slots, np.int32)
+                for j, r in enumerate(chunk):
+                    toks[j, :r.tokens.size] = r.tokens
+                    lens[j] = r.tokens.size
+                    slots[j] = free[j]
+                self._cache_k, self._cache_v, logits = \
+                    self.model.prefill(self._cache_k, self._cache_v,
+                                       toks, lens, slots)
+                logits = np.asarray(logits)
+                now = time.perf_counter()
+                for j, r in enumerate(chunk):
+                    seq = _Seq(r, free[j], r.tokens.size)
+                    self._seqs[free[j]] = seq
+                    self._lengths[free[j]] = r.tokens.size
+                    self._emit(seq, logits[j], now)
+                free = free[n:]
+
+    def _emit(self, seq: _Seq, logits_row: np.ndarray,
+              now: float) -> None:
+        """Sample one token for ``seq`` from its logits row, record
+        latency metrics, and retire the sequence if finished."""
+        tok = int(self.model.sample(
+            logits_row[None], self._next_key(),
+            temperature=seq.req.temperature, top_k=seq.req.top_k)[0])
+        seq.generated.append(tok)
+        seq.last_token = tok
+        if seq.req.return_logits:
+            seq.logits.append(logits_row.copy())
+        telemetry.counter("serve_tokens_total").inc()
+        if seq.t_first is None:
+            seq.t_first = now
+            telemetry.histogram("serve_ttft_seconds").observe(
+                now - seq.req.t_submit)
+        else:
+            telemetry.histogram("serve_token_seconds").observe(
+                now - seq.t_last)
+        seq.t_last = now
+        if seq.done:
+            self._finish(seq)
+
+    def _finish(self, seq: _Seq) -> None:
+        res = GenerationResult(
+            np.asarray(seq.generated, np.int32),
+            np.stack(seq.logits) if seq.logits else None,
+            seq.req.tokens.size, seq.slot,
+            seq.t_first - seq.req.t_submit)
+        self._seqs[seq.slot] = None
+        self._lengths[seq.slot] = 0
+        self.stats.requests += 1
+        telemetry.counter("serve_requests_total").inc()
+        telemetry.counter("serve_slot_recycles_total").inc()
+        telemetry.histogram("serve_request_seconds").observe(
+            time.perf_counter() - seq.req.t_submit)
+        seq.req.future.set_result(res)
+
+    # -------------------------------------------------------------- decode
+    def _decode_step(self) -> None:
+        """One token for every running slot — THE continuous batch."""
+        tokens = np.zeros(self.max_slots, np.int32)
+        active = []
+        for i, seq in enumerate(self._seqs):
+            if seq is not None:
+                tokens[i] = seq.last_token
+                active.append(seq)
+        if not active:
+            return
+        telemetry.histogram("serve_decode_active").observe(len(active))
+        self._cache_k, self._cache_v, logits = self.model.decode(
+            self._cache_k, self._cache_v, tokens, self._lengths)
+        logits = np.asarray(logits)
+        now = time.perf_counter()
+        for seq in active:
+            # the decode wrote this token's K/V at position `length`
+            seq.length += 1
+            self._lengths[seq.slot] = seq.length
+            self._emit(seq, logits[seq.slot], now)
+            # deadline: a running sequence past its deadline stops with
+            # what it has rather than holding the slot
+            if (self._seqs[seq.slot] is seq
+                    and seq.req.deadline is not None
+                    and now > seq.req.deadline):
+                self._finish(seq)

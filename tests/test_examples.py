@@ -131,6 +131,19 @@ def test_train_transformer_lm_moe():
         and "done" in out
 
 
+@pytest.mark.slow
+def test_serve_transformer_lm():
+    """The serving driver: train the shift task, then generate through
+    GenerationEngine under concurrent clients with mixed prompt lengths
+    (compile bound + shift-chain continuation asserted inside)."""
+    out = _run("serve_transformer_lm.py", "--num-epochs", "4",
+               "--seq-len", "16", "--vocab-size", "16",
+               "--embed", "16", "--heads", "2", "--clients", "3",
+               "--requests-per-client", "2", "--new-tokens", "4",
+               "--max-slots", "2")
+    assert "served 6 requests" in out and "done" in out
+
+
 def test_train_ctc_seq():
     """The warpctc family (reference example/warpctc): LSTM + CTCLoss
     learns unsegmented digit sequences to >0.7 exact-match (asserted

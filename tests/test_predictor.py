@@ -51,6 +51,42 @@ def test_predictor_matches_module_forward(tmp_path):
                                atol=1e-6)
 
 
+def test_predictor_input_dtypes(tmp_path):
+    """`input_dtypes` keeps token-id inputs integral end to end (the
+    LM serving path) and rejects unknown names."""
+    from incubator_mxnet_tpu.models import transformer
+
+    net = transformer.get_symbol(vocab_size=11, embed=8, heads=2,
+                                 num_layers=1, seq_len=6, batch_size=2,
+                                 head="softmax")
+    arg_names = net.list_arguments()
+    arg_shapes, _, _ = net.infer_shape(data=(2, 6),
+                                       softmax_label=(2, 6))
+    rng = np.random.RandomState(3)
+    params = {n: rng.randn(*s).astype(np.float32) * 0.1
+              for n, s in zip(arg_names, arg_shapes)
+              if n not in ("data", "softmax_label")}
+    shapes = {"data": (2, 6), "softmax_label": (2, 6)}
+    p = Predictor(net, params, {}, shapes,
+                  input_dtypes={"data": np.int32})
+    toks = rng.randint(0, 11, size=(2, 6))
+    zeros = np.zeros((2, 6), np.float32)
+    p.set_input(data=toks, softmax_label=zeros)
+    assert p._inputs["data"].dtype == np.int32
+    p.forward()
+    out = p.get_output(0)
+    assert out.shape == (12, 11)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+    # same tokens staged as float32 (legacy path) agree
+    p32 = Predictor(net, params, {}, shapes)
+    ref = p32.predict(data=toks.astype(np.float32),
+                      softmax_label=zeros)[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-7)
+    with pytest.raises(MXNetError, match="input_dtypes"):
+        Predictor(net, params, {}, shapes,
+                  input_dtypes={"bogus": np.int32})
+
+
 def test_predictor_validation(tmp_path):
     _, _, _, prefix = _train_and_checkpoint(tmp_path)
     p = Predictor.load(prefix + "-symbol.json", prefix + "-0003.params",

@@ -143,12 +143,50 @@ def check_schedule(problems):
                         + "\n  ".join(tail))
 
 
+def check_serving(problems):
+    """Serving smoke gate (docs/serving.md): batcher invariants, the
+    KV-cache parity oracles (vs the real symbol graph, and through
+    slot recycling), and the mixed-shape compile bound — at most one
+    compiled program per (bucket, phase), asserted via the
+    serve-compile telemetry counter.  The heavy tests here carry
+    ``@pytest.mark.slow`` so the tier-1 sweep skips them; this gate
+    runs them by id, so they stay CI-enforced (needs jax — skip with
+    ``TP_CHECK_SERVE=0``)."""
+    if os.environ.get("TP_CHECK_SERVE", "1") == "0":
+        return
+    import subprocess
+
+    tests = "tests/test_serving.py"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q",
+             "-p", "no:cacheprovider", "-p", "no:randomly",
+             tests + "::test_bucket_math",
+             tests + "::test_engine_batches_and_slices_back",
+             tests + "::test_engine_queue_full_rejects",
+             tests + "::test_kv_forward_matches_symbol_graph",
+             tests
+             + "::test_generation_engine_parity_including_slot_recycle",
+             tests + "::test_generation_compile_bound_under_mixed_load"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        problems.append("serving: smoke run did not finish: %s" % e)
+        return
+    if proc.returncode != 0:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-12:]
+        problems.append("serving: smoke gate failed:\n  "
+                        + "\n  ".join(tail))
+
+
 def main():
     problems = []
     check_compile(problems)
     check_lint(problems)
     check_docs(problems)
     check_schedule(problems)
+    check_serving(problems)
     for p in problems:
         print(p)
     print("%d file(s) checked, %d problem(s)"
