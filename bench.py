@@ -288,6 +288,87 @@ def _serving_record(small):
     return record
 
 
+def _input_pipeline_record(small):
+    """Input-pipeline A/B (docs/input_pipeline.md): the same Module.fit
+    run with the overlapped loop OFF (TP_MAX_INFLIGHT=0, host iterator,
+    per-batch metric readback — the legacy synchronous loop) and ON
+    (bounded in-flight ring + DeviceQueueIter staging + on-device
+    metric partials).  Bit-equal results (tools/check.py gates on it),
+    so the only difference is wall clock; the starvation fraction is
+    the consumer's measured time blocked on the staging queue."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import telemetry
+
+    n, dim, hidden, batch = (256, 64, 64, 32) if small \
+        else (8192, 256, 512, 256)
+    epochs = 2 if small else 3
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, dim).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=hidden)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=10)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    def fit_once(overlap):
+        os.environ["TP_MAX_INFLIGHT"] = "2" if overlap else "0"
+        it = mx.io.NDArrayIter(x, y, batch_size=batch)
+        if overlap:
+            it = mx.io.DeviceQueueIter(it)
+        mod = mx.mod.Module(net, context=mx.cpu())
+        t0 = time.perf_counter()
+        mod.fit(it, num_epoch=epochs, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1})
+        dt = time.perf_counter() - t0
+        if overlap:
+            it.close()
+        return dt
+
+    def _readbacks():
+        # 0 when telemetry is off (the counter is the shared null metric)
+        return getattr(telemetry.counter("metric_readbacks_total"),
+                       "value", 0)
+
+    def _wait_sum():
+        return getattr(telemetry.histogram("input_wait_seconds"),
+                       "sum", 0.0)
+
+    prev = os.environ.get("TP_MAX_INFLIGHT")
+    repeats = 2 if small else 3
+    try:
+        # warmup BOTH variants: the overlapped loop has its own jitted
+        # programs (metric partials, fence slice, staged-input step)
+        # that must not compile inside the timed region
+        fit_once(False)
+        fit_once(True)
+        readbacks0 = _readbacks()
+        dt_off = min(fit_once(False) for _ in range(repeats))
+        wait0 = _wait_sum()
+        readbacks1 = _readbacks()
+        dt_on = min(fit_once(True) for _ in range(repeats))
+        wait = (_wait_sum() - wait0) / repeats
+        readbacks_on = (_readbacks() - readbacks1) // repeats
+    finally:
+        if prev is None:
+            os.environ.pop("TP_MAX_INFLIGHT", None)
+        else:
+            os.environ["TP_MAX_INFLIGHT"] = prev
+    imgs = n * epochs
+    return {
+        "metric": "fit_overlap_imgs_per_sec",
+        "value": round(imgs / dt_on, 1),
+        "unit": "img/s",
+        "imgs_per_sec_sync": round(imgs / dt_off, 1),
+        "speedup_vs_sync": round(dt_off / dt_on, 3),
+        "input_starvation_fraction": round(wait / dt_on, 4),
+        "metric_readbacks_sync": (readbacks1 - readbacks0) // repeats,
+        "metric_readbacks_overlap": readbacks_on,
+        "batch": batch, "epochs": epochs, "samples": n,
+        "max_inflight": 2,
+    }
+
+
 def main():
     small = os.environ.get("TP_BENCH_SMALL") == "1"
     # telemetry snapshot rides along with the BENCH record (JSONL next to
@@ -368,6 +449,10 @@ def main():
     # generation under an offered-load sweep — throughput, p50/p99,
     # padding waste, and the compile count that proves the bucket bound
     combined["serving"] = _serving_record(small)
+    # input-pipeline A/B (docs/input_pipeline.md): Module.fit with the
+    # overlapped loop off vs on — img/s, starvation fraction, and the
+    # metric-readback counts (O(steps) vs O(steps/window))
+    combined["input_pipeline"] = _input_pipeline_record(small)
     # vs_baseline keeps the ResNet-vs-P100 anchor (BASELINE.md has no
     # reference LM throughput to anchor tokens/s against); the nested
     # record carries its full provenance

@@ -23,6 +23,7 @@ class Speedometer:
         self.init = False
         self.tic = 0.0
         self.last_count = 0
+        self.last_tick = 0
         self.auto_reset = auto_reset
 
     def __call__(self, param):
@@ -36,7 +37,12 @@ class Speedometer:
                 # negative elapsed; clamp avoids ZeroDivisionError when two
                 # callbacks land within timer resolution
                 elapsed = time.monotonic() - self.tic
-                speed = self.frequent * self.batch_size / max(elapsed, 1e-9)
+                # exact window: batches completed since the previous
+                # tick (fit reports nbatch as the completed-batch count,
+                # so the delta is right even on the first window — the
+                # old `frequent * batch_size` overcounted it)
+                n = max(count - self.last_tick, 1)
+                speed = n * self.batch_size / max(elapsed, 1e-9)
                 telemetry.gauge("speedometer_samples_per_sec").set(speed)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
@@ -51,9 +57,11 @@ class Speedometer:
                         "Iter[%d] Batch [%d]	Speed: %.2f samples/sec",
                         param.epoch, count, speed)
                 self.tic = time.monotonic()
+                self.last_tick = count
         else:
             self.init = True
             self.tic = time.monotonic()
+            self.last_tick = count
 
 
 def do_checkpoint(prefix: str, period: int = 1):

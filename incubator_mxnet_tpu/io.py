@@ -18,13 +18,14 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from . import telemetry
 from .base import MXNetError
 from .context import Context, cpu
 from .ndarray import array as nd_array
 from .ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "MNISTIter", "ResizeIter", "PrefetchingIter",
+           "MNISTIter", "ResizeIter", "PrefetchingIter", "DeviceQueueIter",
            "ImageRecordIter", "ImageRecordUInt8Iter"]
 
 
@@ -354,6 +355,7 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None] * self.n_iter
         self.next_batch = [None] * self.n_iter
+        self._errors: List[Optional[BaseException]] = [None] * self.n_iter
 
         def prefetch(i):
             while True:
@@ -363,6 +365,13 @@ class PrefetchingIter(DataIter):
                 try:
                     self.next_batch[i] = self.iters[i].next()
                 except StopIteration:
+                    self.next_batch[i] = None
+                except Exception as exc:
+                    # anything else must NOT kill the thread silently —
+                    # data_ready would never set and the consumer would
+                    # block forever in iter_next(); record it for
+                    # re-raise on the consumer thread instead
+                    self._errors[i] = exc
                     self.next_batch[i] = None
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
@@ -391,16 +400,28 @@ class PrefetchingIter(DataIter):
                      for x in i.provide_label]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
-    def __del__(self):
+    def close(self, timeout: Optional[float] = None):
+        """Stop the prefetch threads deterministically (don't rely on
+        ``__del__`` — GC order at interpreter shutdown is undefined and
+        a still-parked worker would pin its iterators alive)."""
         self.started = False
         for e in self.data_taken:
             e.set()
+        for t in self.prefetch_threads:
+            t.join(timeout=timeout)
+
+    def __del__(self):
+        try:
+            self.close(timeout=2.0)  # bounded: never hang process exit
+        except Exception:
+            pass
 
     def reset(self):
         for e in self.data_ready:
             e.wait()
         for i in self.iters:
             i.reset()
+        self._errors = [None] * self.n_iter
         for e in self.data_ready:
             e.clear()
         for e in self.data_taken:
@@ -409,7 +430,15 @@ class PrefetchingIter(DataIter):
     def iter_next(self) -> bool:
         for e in self.data_ready:
             e.wait()
-        if self.next_batch[0] is None:
+        for exc in self._errors:
+            if exc is not None:
+                # stay armed (ready set, taken clear): every subsequent
+                # call re-raises fast instead of handing the dead slot
+                # back to the worker
+                raise exc
+        if any(b is None for b in self.next_batch):
+            # ANY exhausted source ends the epoch — index 0 alone would
+            # zip mismatched-length iters into a crash below
             return False
         self.current_batch = DataBatch(
             sum([b.data for b in self.next_batch], []),
@@ -436,6 +465,171 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+class DeviceQueueIter(DataIter):
+    """Device-staging prefetcher: wrap any ``DataIter`` and keep the
+    next K batches RESIDENT ON DEVICE (``docs/input_pipeline.md``).
+
+    A background thread pulls host batches from the wrapped iterator and
+    ``jax.device_put``s each array — with the step's batch sharding when
+    a ``mesh``/``sharding`` is given — into a bounded queue of depth
+    ``TP_DEVICE_PREFETCH`` (default 2).  The H2D copy therefore overlaps
+    the running step instead of serializing in front of it; the train
+    loop's ``next()`` returns already-staged arrays that
+    ``FusedTrainStep`` / the executor consume without a further put.
+
+    ``mesh=`` reuses the fused-step batch placement
+    (:func:`..parallel.mesh.data_parallel_spec`: batch axis over ``dp``,
+    rest replicated); ``sharding=`` pins an explicit
+    ``jax.sharding.Sharding``; ``device=`` a single device; default is
+    the first local device.  Telemetry: ``input_wait_seconds`` (how long
+    the consumer waited — the input-starvation signal), ``h2d_bytes``,
+    ``device_prefetch_batches_total``.
+    """
+
+    def __init__(self, data_iter: DataIter, depth: Optional[int] = None,
+                 mesh=None, sharding=None, device=None):
+        from .base import get_env
+
+        super().__init__(data_iter.batch_size)
+        if sum(x is not None for x in (mesh, sharding, device)) > 1:
+            raise MXNetError(
+                "pass at most one of mesh=, sharding=, device=")
+        self.data_iter = data_iter
+        if depth is None:
+            depth = get_env("DEVICE_PREFETCH", 2, int)
+        self.depth = max(1, int(depth))
+        self._mesh = mesh
+        self._sharding = sharding
+        self._device = device
+        self._queue = None
+        self._worker = None
+        self._stop = False
+        self._start()
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    # ---------------------------------------------------------- staging
+    def _placement(self, ndim: int):
+        if self._sharding is not None:
+            return self._sharding
+        if self._mesh is not None:
+            from .parallel.mesh import data_parallel_spec
+
+            return data_parallel_spec(self._mesh, ndim)
+        if self._device is not None:
+            return self._device
+        import jax
+
+        return jax.devices()[0]
+
+    def _stage(self, arr):
+        """One array → device, on the WORKER thread (H2D overlaps the
+        running step)."""
+        import jax
+
+        a = arr.data if isinstance(arr, NDArray) else arr
+        host = not isinstance(a, jax.Array)
+        if host:
+            a = np.ascontiguousarray(a)
+        dev = jax.device_put(a, self._placement(a.ndim))
+        if host:
+            telemetry.counter("h2d_bytes").inc(int(a.nbytes))
+        return NDArray(dev)
+
+    def _start(self):
+        import queue as queue_mod
+
+        self._queue = queue_mod.Queue(maxsize=self.depth)
+        self._stop = False
+
+        def worker():
+            try:
+                while not self._stop:
+                    try:
+                        batch = self.data_iter.next()
+                    except StopIteration:
+                        self._queue.put(None)
+                        return
+                    staged = DataBatch(
+                        [self._stage(d) for d in batch.data],
+                        [self._stage(l) for l in (batch.label or [])],
+                        pad=batch.pad, index=batch.index,
+                        bucket_key=batch.bucket_key,
+                        provide_data=batch.provide_data,
+                        provide_label=batch.provide_label)
+                    telemetry.counter(
+                        "device_prefetch_batches_total").inc()
+                    self._queue.put(staged)
+            except Exception as exc:  # surface to the consumer, no hang
+                self._queue.put(exc)
+
+        self._worker = threading.Thread(target=worker, daemon=True)
+        self._worker.start()
+
+    # --------------------------------------------------------- consumer
+    def next(self) -> DataBatch:
+        import time as time_mod
+
+        t0 = time_mod.monotonic()
+        item = self._queue.get()
+        # the starvation signal: ~0 when staging keeps ahead of compute
+        telemetry.histogram("input_wait_seconds").observe(
+            time_mod.monotonic() - t0)
+        if item is None:
+            # keep the sentinel so repeated next() keeps raising rather
+            # than blocking on the dead worker
+            self._queue.put(None)
+            raise StopIteration
+        if isinstance(item, Exception):
+            self._queue.put(item)  # re-arm: fail fast on every call
+            raise item
+        return item
+
+    __next__ = next
+
+    # -------------------------------------------------------- lifecycle
+    def _drain_worker(self, deadline: Optional[float] = None):
+        import queue as queue_mod
+        import time as time_mod
+
+        self._stop = True
+        if self._worker is None:
+            return
+        t0 = time_mod.monotonic()
+        while self._worker.is_alive():
+            if deadline is not None \
+                    and time_mod.monotonic() - t0 > deadline:
+                return
+            try:
+                self._queue.get(timeout=0.1)
+            except queue_mod.Empty:
+                pass
+        self._worker.join()
+
+    def reset(self):
+        # drain so the dead epoch's worker cannot race the next epoch's
+        # worker on the shared inner iterator
+        self._drain_worker()
+        self.data_iter.reset()
+        self._start()
+
+    def close(self, timeout: Optional[float] = None):
+        """Stop the staging worker deterministically."""
+        self._drain_worker(deadline=timeout)
+
+    def __del__(self):
+        try:
+            self.close(timeout=2.0)  # bounded: never hang process exit
+        except Exception:
+            pass
 
 
 class ImageRecordIter(DataIter):

@@ -15,7 +15,8 @@ from .ndarray.ndarray import NDArray
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
            "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
-           "Caffe", "CustomMetric", "np_metric", "create"]
+           "Caffe", "CustomMetric", "np_metric", "create",
+           "device_partials", "DeviceMetricAccumulator"]
 
 _REG = Registry("metric")
 
@@ -343,6 +344,177 @@ def np_metric(name=None, allow_extra_outputs=False):
                             allow_extra_outputs=allow_extra_outputs)
 
     return wrapper
+
+
+def device_partials(metric):
+    """Pure-jax per-batch partial for a supported ``EvalMetric``.
+
+    Returns ``(fn, dtype)`` where ``fn(label, pred) -> (sum, count)``
+    computes the metric's per-batch contribution ON DEVICE (traceable
+    under ``jax.jit``), or ``None`` when the metric has no device twin
+    (the train loop then falls back to the host per-batch update).
+
+    ``Accuracy`` counts in int32 — argmax tie-breaking (first max) and
+    integer compare-sum match the numpy path exactly, so accumulating on
+    device is BIT-identical to the host metric.  Float-sum metrics
+    (``Loss``, ``CrossEntropy``) accumulate in f32 on device vs float64
+    on host, so their values agree only to f32 precision.
+
+    Exact-type dispatch (``type(m) is``), not isinstance: a subclass may
+    override ``update`` arbitrarily, and a silently wrong device twin is
+    worse than the host fallback.
+    """
+    if type(metric) is Accuracy:
+        axis = metric.axis
+
+        def acc_fn(label, pred):
+            import jax.numpy as jnp
+
+            if pred.ndim > label.ndim:
+                # jnp.argmax ties break to the first max, same as numpy
+                pred = jnp.argmax(pred, axis=axis)
+            pred = pred.astype(jnp.int32).reshape(-1)
+            label = label.astype(jnp.int32).reshape(-1)
+            if pred.shape != label.shape:
+                raise MXNetError("label/pred count mismatch: %s vs %s"
+                                 % (label.shape, pred.shape))
+            return ((pred == label).sum(dtype=jnp.int32),
+                    jnp.int32(label.shape[0]))
+
+        return acc_fn, np.int32
+    if type(metric) in (Loss, Torch, Caffe):
+        def loss_fn(label, pred):
+            import jax.numpy as jnp
+
+            return (pred.sum().astype(jnp.float32),
+                    jnp.float32(pred.size))
+
+        return loss_fn, np.float32
+    if type(metric) in (CrossEntropy, NegativeLogLikelihood):
+        eps = metric.eps
+
+        def ce_fn(label, pred):
+            import jax.numpy as jnp
+
+            lab = label.reshape(-1).astype(jnp.int32)
+            prob = pred[jnp.arange(lab.shape[0]), lab]
+            return ((-jnp.log(prob + eps)).sum().astype(jnp.float32),
+                    jnp.float32(lab.shape[0]))
+
+        return ce_fn, np.float32
+    return None
+
+
+def _partials_key(metric):
+    """Hashable identity of a metric's device twin: two metrics with
+    the same key trace to the same program, so the jitted accumulate
+    is shared (a fresh jit per accumulator would recompile every
+    ``fit``)."""
+    if type(metric) is Accuracy:
+        return ("acc", metric.axis)
+    if type(metric) in (Loss, Torch, Caffe):
+        return ("loss",)
+    if type(metric) in (CrossEntropy, NegativeLogLikelihood):
+        return ("ce", metric.eps)
+    return None
+
+
+# jitted accumulate programs shared across accumulator instances,
+# keyed by _partials_key — see update()
+_ACC_JIT_CACHE: dict = {}
+
+
+class DeviceMetricAccumulator:
+    """On-device metric accumulation: the overlapped-loop replacement
+    for the per-batch ``update_metric`` host sync.
+
+    Per batch, ONE jitted program folds the metric partial of
+    ``(label, pred)`` into a donated 2-element device buffer
+    ``[sum, count]`` — no host readback, so the step pipeline keeps
+    running ahead.  ``drain()`` does a single readback per window/epoch
+    and adds the partials into the wrapped ``EvalMetric``, turning
+    O(steps) metric readbacks into O(steps / window)
+    (``metric_readbacks_total`` counts them).
+    """
+
+    def __init__(self, metric: EvalMetric, spec):
+        self._metric = metric
+        self._fn, self._dtype = spec
+        self._buf = None
+        self._acc = None
+        self.pending = 0
+
+    @classmethod
+    def create(cls, metric: EvalMetric):
+        """Accumulator for ``metric``, or None when unsupported."""
+        spec = device_partials(metric)
+        if spec is None:
+            return None
+        return cls(metric, spec)
+
+    @property
+    def metric(self) -> EvalMetric:
+        return self._metric
+
+    def _zeros(self):
+        import jax.numpy as jnp
+
+        return jnp.zeros((2,), self._dtype)
+
+    def update(self, labels, preds) -> None:
+        """Fold one batch into the device buffer (no host sync).
+
+        ``labels``/``preds`` are NDArrays or jax arrays, paired like
+        ``EvalMetric.update``.
+        """
+        import jax
+
+        if self._acc is None:
+            key = _partials_key(self._metric)
+            self._acc = _ACC_JIT_CACHE.get(key)
+            if self._acc is None:
+                fn = self._fn
+
+                def accumulate(buf, label, pred):
+                    import jax.numpy as jnp
+
+                    s, c = fn(label, pred)
+                    return buf + jnp.stack([s, c]).astype(buf.dtype)
+
+                # donated buffer: the rebind recycles the 8-byte cell
+                # instead of growing a live-buffer chain per step
+                self._acc = jax.jit(accumulate, donate_argnums=(0,))
+                if key is not None:
+                    _ACC_JIT_CACHE[key] = self._acc
+        if self._buf is None:
+            self._buf = self._zeros()
+        if len(labels) != len(preds):
+            raise MXNetError("label/pred count mismatch: %s vs %s"
+                             % (len(labels), len(preds)))
+        for label, pred in zip(labels, preds):
+            lab = label.data if isinstance(label, NDArray) else label
+            prd = pred.data if isinstance(pred, NDArray) else pred
+            self._buf = self._acc(self._buf, lab, prd)
+        self.pending += 1
+
+    def drain(self) -> EvalMetric:
+        """ONE host readback: fold pending partials into the metric,
+        re-zero the device buffer.  Doubles as a true execution fence
+        (the buffer depends on every accumulated step's outputs)."""
+        if self._buf is None or self.pending == 0:
+            return self._metric
+        vals = np.asarray(self._buf)
+        from . import telemetry
+
+        telemetry.counter("metric_readbacks_total").inc()
+        if vals.dtype.kind in "iu":
+            self._metric.sum_metric += int(vals[0])
+        else:
+            self._metric.sum_metric += float(vals[0])
+        self._metric.num_inst += int(vals[1])
+        self._buf = self._zeros()
+        self.pending = 0
+        return self._metric
 
 
 def create(metric, **kwargs) -> EvalMetric:

@@ -150,6 +150,26 @@ class DataParallelExecutorGroup:
                     for o in outs]
         return outs
 
+    def get_output_arrays(self):
+        """Merged outputs as RAW jax arrays — the overlapped train loop
+        fences and accumulates metrics on these every step, so skip the
+        per-call NDArray wrappers ``get_outputs`` allocates."""
+        import jax
+        import jax.numpy as jnp
+
+        outs = []
+        for i in range(len(self.output_names)):
+            per_exec = [e.outputs[i].data for e in self.execs]
+            if len(per_exec) > 1:
+                # slices live on different contexts: gather onto the
+                # first exec's device before the merge
+                dev = next(iter(per_exec[0].devices()))
+                per_exec = [jax.device_put(p, dev) for p in per_exec]
+                outs.append(jnp.concatenate(per_exec, axis=0))
+            else:
+                outs.append(per_exec[0])
+        return outs
+
     def get_input_grads(self, merge_multi_context: bool = True):
         if not self.inputs_need_grad:
             raise MXNetError("bind with inputs_need_grad=True first")

@@ -304,6 +304,12 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         return self._exec_group.get_outputs(merge_multi_context)
 
+    def get_output_arrays(self):
+        """Merged step outputs as raw jax arrays (no NDArray wrap) —
+        the overlapped ``fit`` fence/metric path (executor_group)."""
+        assert self.binded and self.params_initialized
+        return self._exec_group.get_output_arrays()
+
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized and \
             self.inputs_need_grad

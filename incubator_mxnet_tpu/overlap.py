@@ -1,0 +1,101 @@
+"""Bounded async dispatch for the train loops.
+
+Reference analog: the dependency engine's in-flight op window — the
+reference lets steps run ahead of the python loop and throttles on the
+engine queue (SURVEY.md §7, layer 0).  On the TPU port the analogous
+throttle is a ring of per-step fence handles: each step contributes one
+tiny device scalar that depends on that step's work, and the loop
+host-reads the handle of the step N behind before dispatching further.
+
+Why a host READ and not ``jax.block_until_ready``: on the axon platform
+``block_until_ready`` returns at dispatch time, not execution time
+(PERF.md §1) — an unfenced loop enqueues without bound (runaway memory,
+useless latency numbers) while fencing EVERY step serializes H2D,
+compute and readback.  Reading one scalar derived from step N-k keeps at
+most k steps in flight: the true fence PERF.md validated, amortized over
+the window.
+
+``TP_MAX_INFLIGHT`` (default 2) sizes the window; 0 disables overlap and
+restores the fully synchronous legacy loop.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Optional
+
+import numpy as np
+
+from . import telemetry
+from .base import get_env
+
+__all__ = ["max_inflight", "fence_handle", "InflightRing"]
+
+_SLICE_FN = None
+
+
+def max_inflight() -> int:
+    """The ``TP_MAX_INFLIGHT`` window (default 2, floor 0)."""
+    return max(0, int(get_env("MAX_INFLIGHT", 2, int)))
+
+
+def fence_handle(arr):
+    """A tiny device array that depends on ``arr``'s producing program.
+
+    One jitted element slice — reading the result back later fences
+    everything enqueued up to that program (in-order execution per
+    device stream).  The handle is a fresh non-donated array, so it
+    stays valid even when the producing step's other operands were
+    donated and recycled by a later step.
+    """
+    global _SLICE_FN
+    if arr is None:
+        return None
+    import jax
+
+    if _SLICE_FN is None:
+        _SLICE_FN = jax.jit(lambda a: a.reshape((-1,))[:1])
+    return _SLICE_FN(arr)
+
+
+class InflightRing:
+    """Ring of per-step fence handles bounding dispatch depth.
+
+    ``push(handle)`` admits one step; once more than ``depth`` handles
+    are pending, the OLDEST is host-read (true fence) before returning —
+    so at most ``depth`` steps are ever dispatched-but-unfenced.
+    ``drain()`` fences everything (epoch end / before host readbacks
+    that must see finished state).
+    """
+
+    def __init__(self, depth: int, scope: str = "module"):
+        self.depth = max(0, int(depth))
+        self.scope = scope
+        self.high_water = 0
+        self._pending: deque = deque()
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    @staticmethod
+    def _wait(handle) -> None:
+        # host-read one scalar: the only fence that provably waits for
+        # device execution on every platform (PERF.md §1)
+        np.asarray(handle).ravel()[:1]
+        telemetry.counter("inflight_fences_total").inc()
+
+    def push(self, handle: Optional[Any]) -> None:
+        if handle is not None:
+            self._pending.append(handle)
+        while len(self._pending) > self.depth:
+            self._wait(self._pending.popleft())
+        n = len(self._pending)
+        if n > self.high_water:
+            self.high_water = n
+        telemetry.gauge("inflight_depth", {"scope": self.scope}).set(n)
+        telemetry.gauge("inflight_high_water",
+                        {"scope": self.scope}).set(self.high_water)
+
+    def drain(self) -> None:
+        while self._pending:
+            self._wait(self._pending.popleft())
+        telemetry.gauge("inflight_depth", {"scope": self.scope}).set(0)

@@ -178,7 +178,8 @@ class FusedTrainStep:
                  flat_optimizer: bool = False, remat=None,
                  grad_accum: Optional[int] = None,
                  opt_state_dtype=None, grad_dtype=None,
-                 shard_optimizer: Optional[bool] = None):
+                 shard_optimizer: Optional[bool] = None,
+                 metrics=None):
         import jax
         import jax.numpy as jnp
 
@@ -426,6 +427,43 @@ class FusedTrainStep:
             self.opt_states = {n: () for n in self.param_names}
         self.optimizer_state_bytes()  # publish the footprint gauges
         self._key = jax.random.PRNGKey(seed)
+
+        # ---- on-device metrics (docs/input_pipeline.md) -----------------
+        # metrics= folds per-step metric partials (e.g. correct-count +
+        # sample-count) into a donated 2-element device buffer INSIDE the
+        # step program — read_metrics() is then the only host readback,
+        # once per window/epoch instead of per batch.
+        self.metric = None
+        self._metric_spec = None
+        self._metric_buf = None
+        self._metric_label = None
+        if metrics is not None:
+            from .. import metric as metric_mod
+
+            self.metric = metric_mod.create(metrics)
+            self._metric_spec = metric_mod.device_partials(self.metric)
+            if self._metric_spec is None:
+                raise MXNetError(
+                    "metric %r has no device twin (metric."
+                    "device_partials) — drop metrics= and update on host"
+                    % self.metric.name)
+            if not label_shapes:
+                raise MXNetError(
+                    "metrics= needs label_shapes (the partial pairs the "
+                    "first label input with symbol output 0)")
+            self._metric_label = list(label_shapes)[0]
+            self._metric_buf = jax.device_put(
+                np.zeros((2,), self._metric_spec[1]), rep)
+
+        # bounded dispatch window (TP_MAX_INFLIGHT, overlap.py): each
+        # call fences the step N behind via a scalar derived from its
+        # outputs, so at most N steps are ever in flight
+        from ..overlap import InflightRing, max_inflight
+
+        _n_inflight = max_inflight()
+        self._ring = InflightRing(_n_inflight, scope="fused") \
+            if _n_inflight > 0 else None
+
         self._step_fn = self._build(shapes)
 
     # -------------------------------------------------------------- build
@@ -580,12 +618,33 @@ class FusedTrainStep:
                     for n in self.params}
         aux_sh = {n: rep for n in self.aux}
 
+        if self._metric_spec is None:
+            return jax.jit(
+                step,
+                in_shardings=(param_sh, state_sh, aux_sh, None, None,
+                              None, batch_shardings),
+                out_shardings=(param_sh, state_sh, aux_sh, None),
+                donate_argnums=(0, 1, 2))
+
+        metric_fn = self._metric_spec[0]
+        metric_label = self._metric_label
+
+        def step_with_metrics(params, opt_states, aux, mbuf, key, lr, t,
+                              batch):
+            new_params, new_states, new_aux, outs = step(
+                params, opt_states, aux, key, lr, t, batch)
+            # same XLA program as the update: draining the buffer later
+            # also fences the whole step
+            s, c = metric_fn(batch[metric_label], outs[0])
+            mbuf = mbuf + jnp.stack([s, c]).astype(mbuf.dtype)
+            return new_params, new_states, new_aux, mbuf, outs
+
         return jax.jit(
-            step,
-            in_shardings=(param_sh, state_sh, aux_sh, None, None, None,
-                          batch_shardings),
-            out_shardings=(param_sh, state_sh, aux_sh, None),
-            donate_argnums=(0, 1, 2))
+            step_with_metrics,
+            in_shardings=(param_sh, state_sh, aux_sh, rep, None, None,
+                          None, batch_shardings),
+            out_shardings=(param_sh, state_sh, aux_sh, rep, None),
+            donate_argnums=(0, 1, 2, 3))
 
     # ---------------------------------------------------------------- call
     def __call__(self, batch: Dict[str, Any]):
@@ -609,9 +668,23 @@ class FusedTrainStep:
             else:
                 a = jnp.asarray(np.asarray(v, dtype=np.float32))
             vals[n] = a
-        self.params, self.opt_states, self.aux, outs = self._step_fn(
-            self.params, self.opt_states, self.aux, self._key,
-            jnp.float32(lr), jnp.float32(self.num_update), vals)
+        if self._metric_spec is not None:
+            (self.params, self.opt_states, self.aux, self._metric_buf,
+             outs) = self._step_fn(
+                self.params, self.opt_states, self.aux,
+                self._metric_buf, self._key, jnp.float32(lr),
+                jnp.float32(self.num_update), vals)
+        else:
+            self.params, self.opt_states, self.aux, outs = self._step_fn(
+                self.params, self.opt_states, self.aux, self._key,
+                jnp.float32(lr), jnp.float32(self.num_update), vals)
+        if self._ring is not None and outs:
+            from ..overlap import fence_handle
+
+            # bounded async dispatch: fence the step TP_MAX_INFLIGHT
+            # behind on a scalar derived from ITS outputs (outputs are
+            # not donated, so the handle survives later steps)
+            self._ring.push(fence_handle(outs[0]))
         return outs
 
     # -------------------------------------------------------------- fence
@@ -622,8 +695,37 @@ class FusedTrainStep:
         the step, and a large readback would measure the (slow, on some
         platforms wildly variable) D2H path instead (PERF.md §1, §8c).
         """
+        if self._ring is not None:
+            self._ring.drain()
         name = min(self.params, key=lambda n: self.params[n].size)
         return float(np.asarray(self.params[name]).ravel()[0])
+
+    # ------------------------------------------------------------ metrics
+    def read_metrics(self):
+        """Drain the on-device metric buffer into ``self.metric`` with
+        ONE host readback and return the metric.
+
+        Call once per window/epoch — ``metric_readbacks_total`` counts
+        these, O(steps/window) vs the per-batch ``update_metric`` sync.
+        The buffer belongs to the latest step's XLA program, so this is
+        also a true execution fence."""
+        if self._metric_spec is None:
+            raise MXNetError(
+                "construct FusedTrainStep(metrics=...) to accumulate "
+                "metrics on device")
+        import jax
+
+        vals = np.asarray(self._metric_buf)
+        telemetry.counter("metric_readbacks_total").inc()
+        if vals.dtype.kind in "iu":
+            self.metric.sum_metric += int(vals[0])
+        else:
+            self.metric.sum_metric += float(vals[0])
+        self.metric.num_inst += int(vals[1])
+        self._metric_buf = jax.device_put(
+            np.zeros((2,), self._metric_spec[1]),
+            replicated_spec(self.mesh))
+        return self.metric
 
     # -------------------------------------------------------------- state
     def optimizer_state_bytes(self):

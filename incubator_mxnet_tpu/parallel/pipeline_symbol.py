@@ -306,7 +306,8 @@ class SymbolPipelineTrainStep:
                  optimizer_params: Optional[Dict[str, Any]] = None,
                  initializer=None, seed: int = 0,
                  shard_optimizer: Optional[bool] = None,
-                 schedule: Optional[str] = None):
+                 schedule: Optional[str] = None,
+                 async_loss: bool = False):
         import jax
 
         from ..optimizer import fused_update_plan as _fused_update_plan
@@ -331,6 +332,18 @@ class SymbolPipelineTrainStep:
                 "docs/pipeline.md)" % (schedule,
                                        ", ".join(PP_SCHEDULES)))
         self.schedule = schedule
+        # async_loss=True defers the per-step host read of the loss:
+        # __call__ returns the device scalar and a bounded in-flight
+        # ring (TP_MAX_INFLIGHT, overlap.py) fences the step N behind —
+        # the same dispatch window Module.fit and FusedTrainStep use.
+        # Default False keeps the synchronous float return contract.
+        self._async_loss = bool(async_loss)
+        self._ring = None
+        if self._async_loss:
+            from ..overlap import InflightRing, max_inflight
+
+            self._ring = InflightRing(max(1, max_inflight()),
+                                      scope="pipeline")
         self.bubble_fraction = pp_bubble_fraction(self._L, self._M)
         if telemetry.enabled():
             telemetry.gauge(
@@ -760,10 +773,18 @@ class SymbolPipelineTrainStep:
             self._step_fn(self.flat_params, self.opt_states,
                           self.flat_aux, jnp.float32(lr),
                           jnp.float32(self.num_update), data, key)
+        if self._async_loss:
+            # deferred: the loss scalar IS the fence handle — the ring
+            # host-reads the one TP_MAX_INFLIGHT steps behind, keeping
+            # the pipeline dispatched ahead instead of fencing per step
+            self._ring.push(loss)
+            return loss
         return float(loss)
 
     # ------------------------------------------------------------ fence
     def sync(self) -> float:
+        if self._ring is not None:
+            self._ring.drain()
         return float(np.asarray(self.flat_params[0, 0]))
 
     # ----------------------------------------------------------- memory

@@ -1,0 +1,396 @@
+"""Overlapped train loop (docs/input_pipeline.md): bit-equality of the
+bounded-dispatch path vs the synchronous loop, DeviceQueueIter staging,
+on-device metric accumulation, PrefetchingIter failure modes, and the
+epoch-accounting fixes."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import telemetry
+
+
+@pytest.fixture
+def registry(tmp_path):
+    telemetry.disable()
+    reg = telemetry.enable(str(tmp_path / "telemetry.jsonl"))
+    yield reg
+    telemetry.disable()
+
+
+def _make_dataset(n=120, nclass=4, dim=16, seed=3):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(nclass, dim).astype(np.float32) * 3
+    y = rng.randint(0, nclass, n)
+    x = centers[y] + rng.randn(n, dim).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def _mlp(nclass=4):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=nclass)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _init_params(x, y):
+    it = mx.io.NDArrayIter(x, y, batch_size=20)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    np.random.seed(7)
+    mx.random.seed(7)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    return mod.get_params()[0]
+
+
+def _fit(x, y, arg_params, monkeypatch, max_inflight, wrap_device=False,
+         num_epoch=2, **fit_kwargs):
+    monkeypatch.setenv("TP_MAX_INFLIGHT", str(max_inflight))
+    it = mx.io.NDArrayIter(x, y, batch_size=20)
+    if wrap_device:
+        it = mx.io.DeviceQueueIter(it, depth=2)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    metric = mx.metric.Accuracy()
+    mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric=metric,
+            arg_params={k: v.copy() for k, v in arg_params.items()},
+            **fit_kwargs)
+    if wrap_device:
+        it.close()
+    params = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    return params, metric
+
+
+# ---------------------------------------------------------------------------
+# bit-equality: overlap on/off must not change training
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("max_inflight", [1, 2, 4],
+                         ids=["inflight=1", "inflight=2", "inflight=4"])
+def test_fit_overlap_bit_equal(monkeypatch, max_inflight):
+    """TP_MAX_INFLIGHT in {1,2,4} (ring + on-device metrics) vs the
+    synchronous loop (0): final params AND metric values bit-identical —
+    overlap reorders dispatch, never computation."""
+    x, y = _make_dataset()
+    init = _init_params(x, y)
+    ps, ms = _fit(x, y, init, monkeypatch, max_inflight=0)
+    po, mo = _fit(x, y, init, monkeypatch, max_inflight=max_inflight)
+    assert set(ps) == set(po)
+    for name in ps:
+        assert np.array_equal(ps[name], po[name]), name
+    assert ms.sum_metric == mo.sum_metric
+    assert ms.num_inst == mo.num_inst
+    assert ms.get() == mo.get()
+
+
+def test_fit_overlap_with_device_queue_bit_equal(monkeypatch):
+    """The full overlapped input pipeline — DeviceQueueIter staging +
+    inflight ring + device metrics — matches the sync loop bit-for-bit
+    (the check-gate contract)."""
+    x, y = _make_dataset()
+    init = _init_params(x, y)
+    ps, ms = _fit(x, y, init, monkeypatch, max_inflight=0)
+    po, mo = _fit(x, y, init, monkeypatch, max_inflight=2,
+                  wrap_device=True)
+    for name in ps:
+        assert np.array_equal(ps[name], po[name]), name
+    assert ms.get() == mo.get()
+
+
+def test_fused_device_metrics_bit_equal(monkeypatch):
+    """FusedTrainStep(metrics='acc'): the in-program partial buffer,
+    drained once at the end, equals the host Accuracy fed per-batch from
+    the same outputs — exactly (integer counting on both sides)."""
+    monkeypatch.setenv("TP_MAX_INFLIGHT", "2")
+    from incubator_mxnet_tpu import parallel
+
+    x, y = _make_dataset(n=80)
+    mesh = parallel.default_mesh(1)
+
+    def build(**kw):
+        mx.random.seed(5)
+        return parallel.FusedTrainStep(
+            _mlp(), {"data": (20, 16)}, {"softmax_label": (20,)},
+            mesh=mesh, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(), **kw)
+
+    dev = build(metrics="acc")
+    host = build()
+    host_metric = mx.metric.Accuracy()
+    for i in range(4):
+        batch = {"data": x[i * 20:(i + 1) * 20],
+                 "softmax_label": y[i * 20:(i + 1) * 20]}
+        outs_d = dev(batch)
+        outs_h = host(batch)
+        host_metric.update([y[i * 20:(i + 1) * 20]],
+                           [np.asarray(outs_h[0])])
+        np.testing.assert_array_equal(np.asarray(outs_d[0]),
+                                      np.asarray(outs_h[0]))
+    dev_metric = dev.read_metrics()
+    assert dev_metric.sum_metric == host_metric.sum_metric
+    assert dev_metric.num_inst == host_metric.num_inst == 80
+    # drained: a second read adds nothing
+    assert dev.read_metrics().num_inst == 80
+
+
+def test_fused_metrics_rejects_unsupported():
+    from incubator_mxnet_tpu import parallel
+
+    with pytest.raises(mx.base.MXNetError):
+        parallel.FusedTrainStep(
+            _mlp(), {"data": (20, 16)}, {"softmax_label": (20,)},
+            mesh=parallel.default_mesh(1), optimizer="sgd",
+            metrics="mae")
+
+
+# ---------------------------------------------------------------------------
+# DeviceQueueIter
+# ---------------------------------------------------------------------------
+
+
+def test_device_queue_iter_bit_equal():
+    """Staged batches are the plain iterator's batches, bit for bit,
+    across two epochs (reset path included)."""
+    x, y = _make_dataset(n=90)
+    plain = mx.io.NDArrayIter(x, y, batch_size=20)
+    staged = mx.io.DeviceQueueIter(mx.io.NDArrayIter(x, y, batch_size=20),
+                                   depth=3)
+    try:
+        for _ in range(2):
+            n = 0
+            for pb, sb in zip(plain, staged):
+                assert sb.pad == pb.pad
+                for a, b in zip(pb.data, sb.data):
+                    np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+                for a, b in zip(pb.label, sb.label):
+                    np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+                n += 1
+            assert n == 5  # 90/20 padded -> 5 batches
+            with pytest.raises(StopIteration):
+                staged.next()
+            plain.reset()
+            staged.reset()
+    finally:
+        staged.close()
+
+
+def test_device_queue_iter_stages_on_device():
+    import jax
+
+    x, y = _make_dataset(n=40)
+    it = mx.io.DeviceQueueIter(mx.io.NDArrayIter(x, y, batch_size=20))
+    try:
+        batch = it.next()
+        assert isinstance(batch.data[0].data, jax.Array)
+        assert it.provide_data[0].shape == (20, 16)
+    finally:
+        it.close()
+
+
+def test_device_queue_iter_mesh_sharding():
+    """mesh= stages with the fused step's batch placement: batch axis
+    split over dp, rest replicated."""
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.parallel.mesh import data_parallel_spec
+
+    mesh = parallel.default_mesh(2)
+    x, y = _make_dataset(n=40)
+    it = mx.io.DeviceQueueIter(mx.io.NDArrayIter(x, y, batch_size=20),
+                               mesh=mesh)
+    try:
+        batch = it.next()
+        assert batch.data[0].data.sharding == data_parallel_spec(mesh, 2)
+        assert batch.label[0].data.sharding == data_parallel_spec(mesh, 1)
+    finally:
+        it.close()
+
+
+class _FailingIter(mx.io.DataIter):
+    def __init__(self, fail_at=2):
+        super().__init__(batch_size=4)
+        self.provide_data = [mx.io.DataDesc("data", (4, 2))]
+        self.provide_label = [mx.io.DataDesc("softmax_label", (4,))]
+        self.fail_at = fail_at
+        self.cur = 0
+
+    def reset(self):
+        self.cur = 0
+
+    def next(self):
+        self.cur += 1
+        if self.cur > self.fail_at:
+            raise RuntimeError("boom at batch %d" % self.cur)
+        return mx.io.DataBatch([mx.nd.ones((4, 2))], [mx.nd.zeros((4,))])
+
+    __next__ = next
+
+
+def test_device_queue_iter_propagates_worker_exception():
+    it = mx.io.DeviceQueueIter(_FailingIter(fail_at=2), depth=2)
+    try:
+        it.next()
+        it.next()
+        with pytest.raises(RuntimeError, match="boom"):
+            for _ in range(3):
+                it.next()
+        # fail-fast stays armed, no hang
+        with pytest.raises(RuntimeError, match="boom"):
+            it.next()
+    finally:
+        it.close()
+
+
+# ---------------------------------------------------------------------------
+# PrefetchingIter satellite fixes
+# ---------------------------------------------------------------------------
+
+
+def test_prefetching_iter_propagates_worker_exception():
+    """A non-StopIteration worker error must re-raise in the consumer
+    (previously the thread died silently and iter_next blocked forever)."""
+    it = mx.io.PrefetchingIter(_FailingIter(fail_at=1))
+    try:
+        it.next()
+        with pytest.raises(RuntimeError, match="boom"):
+            it.next()
+        # error stays armed on repeated calls instead of hanging
+        with pytest.raises(RuntimeError, match="boom"):
+            it.next()
+    finally:
+        it.close(timeout=2.0)
+
+
+def test_prefetching_iter_stops_at_shortest():
+    """Exhaustion checks ALL sources, not just index 0: a shorter
+    NON-first iterator ends the epoch cleanly."""
+    x, y = _make_dataset(n=80)
+    long_it = mx.io.NDArrayIter(x, y, batch_size=20)          # 4 batches
+    short_it = mx.io.NDArrayIter(x[:40], y[:40], batch_size=20)  # 2
+    it = mx.io.PrefetchingIter([long_it, short_it])
+    try:
+        n = 0
+        for _ in it:
+            n += 1
+        assert n == 2
+    finally:
+        it.close(timeout=2.0)
+
+
+def test_prefetching_iter_close_joins_threads():
+    x, y = _make_dataset(n=40)
+    it = mx.io.PrefetchingIter(mx.io.NDArrayIter(x, y, batch_size=20))
+    it.next()
+    it.close(timeout=2.0)
+    assert all(not t.is_alive() for t in it.prefetch_threads)
+
+
+# ---------------------------------------------------------------------------
+# in-flight bound + readback telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_fit_inflight_bound_via_gauge(monkeypatch, registry):
+    """The ring never holds more than TP_MAX_INFLIGHT unfenced steps
+    (asserted via the inflight gauges), and device metrics reduce
+    readbacks to O(steps/window)."""
+    monkeypatch.setenv("TP_MAX_INFLIGHT", "2")
+    monkeypatch.setenv("TP_METRIC_WINDOW", "3")
+    x, y = _make_dataset()
+    init = _init_params(x, y)
+    _fit(x, y, init, monkeypatch, max_inflight=2, num_epoch=2)
+    hw = telemetry.gauge("inflight_high_water", {"scope": "module"}).value
+    assert 1 <= hw <= 2
+    assert telemetry.gauge("inflight_depth", {"scope": "module"}).value == 0
+    # 6 batches/epoch, window 3 -> 2 drains per epoch, 2 epochs = 4
+    # (vs 12 per-batch syncs on the legacy path)
+    readbacks = telemetry.counter("metric_readbacks_total").value
+    assert 0 < readbacks <= 4
+
+
+def test_fused_ring_bound(monkeypatch):
+    monkeypatch.setenv("TP_MAX_INFLIGHT", "2")
+    from incubator_mxnet_tpu import parallel
+
+    step = parallel.FusedTrainStep(
+        _mlp(), {"data": (20, 16)}, {"softmax_label": (20,)},
+        mesh=parallel.default_mesh(1), optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1})
+    x, y = _make_dataset(n=20)
+    for _ in range(5):
+        step({"data": x, "softmax_label": y})
+    assert step._ring is not None
+    assert step._ring.high_water <= 2
+    step.sync()
+    assert len(step._ring) == 0
+
+
+def test_pipeline_async_loss_ring(monkeypatch):
+    monkeypatch.setenv("TP_MAX_INFLIGHT", "2")
+    from incubator_mxnet_tpu import parallel
+
+    mesh = parallel.build_mesh({"pp": 2})
+    mx.random.seed(0)
+    step = parallel.SymbolPipelineTrainStep(
+        _mlp(), {"data": (8, 16)}, {"softmax_label": (8,)},
+        mesh=mesh, num_microbatches=2, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1}, async_loss=True)
+    x, y = _make_dataset(n=8)
+    losses = [step({"data": x, "softmax_label": y}) for _ in range(4)]
+    assert not isinstance(losses[0], float)  # deferred device scalar
+    assert step._ring.high_water <= 2
+    step.sync()
+    assert len(step._ring) == 0
+    assert np.isfinite(float(np.asarray(losses[-1])))
+
+
+# ---------------------------------------------------------------------------
+# epoch accounting satellites
+# ---------------------------------------------------------------------------
+
+
+def test_batch_end_param_nbatch_counts_completed(monkeypatch):
+    """BatchEndParam.nbatch is the number of COMPLETED batches when the
+    callback fires (1..N per epoch), not the stale pre-increment index."""
+    monkeypatch.setenv("TP_MAX_INFLIGHT", "2")
+    seen = []
+    x, y = _make_dataset(n=60)
+    it = mx.io.NDArrayIter(x, y, batch_size=20)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            batch_end_callback=lambda p: seen.append((p.epoch, p.nbatch)))
+    assert seen == [(0, 1), (0, 2), (0, 3), (1, 1), (1, 2), (1, 3)]
+
+
+def test_speedometer_exact_window(monkeypatch, registry):
+    """Delta-based speed: frequent*batch/elapsed was wrong whenever the
+    first window didn't span exactly `frequent` batches."""
+    import incubator_mxnet_tpu.callback as cb
+
+    clock = {"t": 100.0}
+    monkeypatch.setattr(cb.time, "monotonic", lambda: clock["t"])
+    sp = mx.callback.Speedometer(batch_size=10, frequent=2)
+
+    class _P:
+        epoch = 0
+        eval_metric = None
+
+    p = _P()
+    p.nbatch = 1
+    sp(p)  # init tick at count=1
+    clock["t"] = 101.0
+    p.nbatch = 2
+    sp(p)  # window spans ONE batch (2-1), 1s -> 10 samples/s
+    assert telemetry.gauge("speedometer_samples_per_sec").value \
+        == pytest.approx(10.0)
+    clock["t"] = 102.0
+    p.nbatch = 3
+    sp(p)
+    clock["t"] = 103.0
+    p.nbatch = 4
+    sp(p)  # two batches (4-2) in 2s -> still 10 samples/s
+    assert telemetry.gauge("speedometer_samples_per_sec").value \
+        == pytest.approx(10.0)

@@ -18,7 +18,10 @@ Checks, in order:
 4. **schedule** — the fast 1F1B↔GPipe pipeline-schedule equivalence
    subset (table invariants + one executed bit-equality case,
    ``tests/test_pipeline_schedule.py``; needs jax — skip with
-   ``TP_CHECK_SCHEDULE=0``).
+   ``TP_CHECK_SCHEDULE=0``);
+5. **serving** — the serving smoke subset (``TP_CHECK_SERVE=0`` skips);
+6. **overlap** — the overlapped-train-loop bit-equality subset
+   (``tests/test_overlap.py``; ``TP_CHECK_OVERLAP=0`` skips).
 
 Exit code 0 = clean; 1 = findings (printed one per line).
 """
@@ -180,6 +183,38 @@ def check_serving(problems):
                         + "\n  ".join(tail))
 
 
+def check_overlap(problems):
+    """Overlap-equality gate (docs/input_pipeline.md): the bounded
+    dispatch window, device staging, and on-device metrics must leave
+    parameters AND metric values bit-identical to the synchronous
+    loop (TP_MAX_INFLIGHT=0), and the in-flight ring must respect its
+    bound (needs jax — skip with ``TP_CHECK_OVERLAP=0``)."""
+    if os.environ.get("TP_CHECK_OVERLAP", "1") == "0":
+        return
+    import subprocess
+
+    tests = "tests/test_overlap.py"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q",
+             "-p", "no:cacheprovider", "-p", "no:randomly",
+             tests + "::test_fit_overlap_bit_equal[inflight=2]",
+             tests + "::test_fit_overlap_with_device_queue_bit_equal",
+             tests + "::test_fused_device_metrics_bit_equal",
+             tests + "::test_fit_inflight_bound_via_gauge",
+             tests + "::test_prefetching_iter_propagates_worker_exception"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        problems.append("overlap: equality run did not finish: %s" % e)
+        return
+    if proc.returncode != 0:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-12:]
+        problems.append("overlap: bit-equality gate failed:\n  "
+                        + "\n  ".join(tail))
+
+
 def main():
     problems = []
     check_compile(problems)
@@ -187,6 +222,7 @@ def main():
     check_docs(problems)
     check_schedule(problems)
     check_serving(problems)
+    check_overlap(problems)
     for p in problems:
         print(p)
     print("%d file(s) checked, %d problem(s)"
