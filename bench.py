@@ -188,20 +188,9 @@ def _pipeline_record(small):
     }
 
 
-def _serving_record(small):
-    """Serving sub-record (docs/serving.md): offered-load sweep over the
-    continuous-batching GenerationEngine — throughput, p50/p99 request
-    latency, padding waste and the compiled-program count that proves
-    the bucketing bound (one program per (bucket, phase))."""
-    import threading
-
-    from incubator_mxnet_tpu import serving
-
-    rng = np.random.RandomState(0)
-    V, E, H, NL, S = (32, 32, 4, 1, 32) if small else (512, 256, 8, 4, 256)
-    slots = 4 if small else 8
-    new_tokens = 4 if small else 16
-    n_requests = 12 if small else 64
+def _toy_lm_params(rng, V, E, NL, S):
+    """Serving-shaped toy transformer params, shared by the serving and
+    quantization sub-records."""
     params = {"tok_embed_weight": rng.randn(V, E).astype(np.float32) * .1,
               "pos_embed_weight": rng.randn(S, E).astype(np.float32) * .1,
               "ln_f_gamma": np.ones(E, np.float32),
@@ -219,6 +208,24 @@ def _serving_record(small):
             full = "block%d_%s" % (i, n)
             params[full] = (np.ones(s, np.float32) if "gamma" in n
                             else rng.randn(*s).astype(np.float32) * 0.1)
+    return params
+
+
+def _serving_record(small):
+    """Serving sub-record (docs/serving.md): offered-load sweep over the
+    continuous-batching GenerationEngine — throughput, p50/p99 request
+    latency, padding waste and the compiled-program count that proves
+    the bucketing bound (one program per (bucket, phase))."""
+    import threading
+
+    from incubator_mxnet_tpu import serving
+
+    rng = np.random.RandomState(0)
+    V, E, H, NL, S = (32, 32, 4, 1, 32) if small else (512, 256, 8, 4, 256)
+    slots = 4 if small else 8
+    new_tokens = 4 if small else 16
+    n_requests = 12 if small else 64
+    params = _toy_lm_params(rng, V, E, NL, S)
     model = serving.KVTransformerLM(params, heads=H)
     plens = [int(rng.randint(1, S - new_tokens - 1))
              for _ in range(n_requests)]
@@ -285,6 +292,53 @@ def _serving_record(small):
         record["num_compiles_after_warmup"] = \
             model.stats.num_compiles - base_compiles
         record["requests"] = model.stats.requests
+    return record
+
+
+def _quantization_record(small):
+    """Quantization sub-record (docs/quantization.md): decode tokens/s
+    with int8 weight-only vs f32 weights at batch 1 and batch 8 — the
+    weight-bandwidth-bound regime the int8 path targets — plus the HBM
+    weight bytes each variant actually parks.  The timed region drives
+    ``KVTransformerLM.decode`` directly (no engine queueing) and ends
+    with a logits readback, the same execution fence as the headline."""
+    from incubator_mxnet_tpu import serving
+
+    V, E, H, NL, S = (32, 32, 4, 1, 32) if small else (512, 256, 8, 4, 256)
+    steps = 8 if small else 64
+    record = {"metric": "quant_int8_decode_tokens_per_sec",
+              "unit": "tokens/s", "vocab": V, "embed": E, "layers": NL,
+              "decode_steps": steps}
+    for wdt in (None, "int8"):
+        m = serving.KVTransformerLM(
+            _toy_lm_params(np.random.RandomState(0), V, E, NL, S),
+            heads=H, weight_dtype=wdt)
+        sub = {"weight_bytes": int(m.weight_bytes)}
+        for bs in (1, 8):
+            ck, cv = m.init_cache(bs, S)
+            toks = np.zeros((bs, 8), np.int32)
+            toks[:, 0] = np.arange(bs) % V
+            ck, cv, _ = m.prefill(ck, cv, toks,
+                                  np.ones(bs, np.int32),
+                                  np.arange(bs, dtype=np.int32))
+            lengths = np.ones(bs, np.int32)
+            cur = np.zeros(bs, np.int32)
+            ck, cv, lg = m.decode(ck, cv, cur, lengths)  # compile
+            lengths += 1
+            np.asarray(lg)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                ck, cv, lg = m.decode(ck, cv, cur, lengths)
+                lengths += 1
+            np.asarray(lg)  # readback = execution fence
+            dt = time.perf_counter() - t0
+            sub["batch%d_tokens_per_sec" % bs] = \
+                round(bs * steps / dt, 1)
+        record["int8" if wdt else "f32"] = sub
+    record["value"] = record["int8"]["batch1_tokens_per_sec"]
+    record["weight_bytes_ratio"] = round(
+        record["int8"]["weight_bytes"]
+        / record["f32"]["weight_bytes"], 3)
     return record
 
 
@@ -449,6 +503,16 @@ def main():
     # generation under an offered-load sweep — throughput, p50/p99,
     # padding waste, and the compile count that proves the bucket bound
     combined["serving"] = _serving_record(small)
+    # quantization sub-record (docs/quantization.md): int8 weight-only
+    # decode A/B at batch 1/8 + parked HBM weight bytes, and the same
+    # flagship train step with fp8 delayed-scaling matmuls — defaults
+    # stay f32/bf16, so both ride along instead of touching headlines
+    combined["quantization"] = _quantization_record(small)
+    fp8_lm = bench_lm.run(defaults=dict(lm_defaults,
+                                        TP_LM_MATMUL_DTYPE="fp8"))
+    combined["quantization"]["fp8_train"] = {
+        k: fp8_lm[k] for k in ("value", "model_tflops_per_sec",
+                               "mfu_vs_sustained", "matmul_dtype")}
     # input-pipeline A/B (docs/input_pipeline.md): Module.fit with the
     # overlapped loop off vs on — img/s, starvation fraction, and the
     # metric-readback counts (O(steps) vs O(steps/window))

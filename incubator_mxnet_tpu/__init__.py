@@ -79,7 +79,7 @@ _OPTIONAL = [
     ("test_utils", ()), ("parallel", ()), ("models", ()), ("gluon", ()),
     ("rnn", ()), ("image", ()), ("operator", ()), ("rtc", ()),
     ("contrib", ()), ("log", ()), ("libinfo", ()), ("torch", ()),
-    ("predictor", ()), ("serving", ()),
+    ("predictor", ()), ("serving", ()), ("quant", ()),
 ]
 
 import importlib as _importlib

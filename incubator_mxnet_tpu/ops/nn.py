@@ -93,13 +93,17 @@ def _fc_infer_shape(in_shapes, attrs):
 @register("FullyConnected", arg_names=_fc_args, infer_shape=_fc_infer_shape)
 def _fully_connected(ins, attrs, ctx):
     """y = x·Wᵀ + b (``src/operator/fully_connected-inl.h``); weight layout
-    (num_hidden, in_dim) as in the reference."""
+    (num_hidden, in_dim) as in the reference.  The matmul goes through
+    ``quant.site_dot`` — a plain ``jnp.matmul(x, w.T)`` unless a
+    quantized-matmul context is active (docs/quantization.md)."""
+    from .. import quant
+
     flatten = parse_bool(attrs.get("flatten", True))
     x = ins[0]
     w = ins[1].astype(x.dtype)  # mixed precision: compute in act dtype
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
-    y = jnp.matmul(x, w.T)
+    y = quant.site_dot(x, w)
     if len(ins) > 2:
         y = y + ins[2].astype(y.dtype)
     return y

@@ -128,13 +128,27 @@ class Predictor:
 
     # ------------------------------------------------------- C-API form
     def set_input(self, **inputs) -> None:
-        """``MXPredSetInput``: stage named input arrays."""
+        """``MXPredSetInput``: stage named input arrays.
+
+        An explicitly declared dtype (``input_dtypes``) always wins —
+        quantized checkpoints can declare int8/uint8 inputs and they
+        reach the graph untouched.  Undeclared inputs get the default
+        mapping: integer/bool arrays stay integral (64-bit narrows to
+        32 for the jax default-x32 config), floats land on f32."""
         for n, v in inputs.items():
             if n not in self._shapes:
                 raise MXNetError("unknown input %r (declared: %s)"
                                  % (n, self._input_names))
-            a = np.asarray(v.data if hasattr(v, "data") else v,
-                           dtype=self._dtypes.get(n, np.float32))
+            a = np.asarray(v.data if hasattr(v, "data") else v)
+            want = self._dtypes.get(n)
+            if want is not None:
+                a = a.astype(want, copy=False)
+            elif a.dtype == np.int64:
+                a = a.astype(np.int32)
+            elif a.dtype == np.uint64:
+                a = a.astype(np.uint32)
+            elif a.dtype.kind not in "iub":
+                a = a.astype(np.float32, copy=False)
             if tuple(a.shape) != self._shapes[n]:
                 raise MXNetError("input %r has shape %s, expected %s"
                                  % (n, a.shape, self._shapes[n]))

@@ -1,0 +1,84 @@
+"""Quantized compute paths (docs/quantization.md).
+
+Two independent byte-halving levers, both off by default:
+
+- **fp8 matmul training** (``TP_MATMUL_DTYPE=fp8``): every
+  ``FullyConnected`` matmul inside ``FusedTrainStep`` runs through
+  :func:`fp8.scaled_dot` — e4m3 forward / e5m2 backward casts with
+  delayed per-tensor amax scaling, f32 masters untouched.
+- **int8 weight-only serving** (``TP_SERVE_WEIGHT_DTYPE=int8``):
+  transformer weights stored int8 + per-output-channel scale in HBM,
+  dequant fused into the decode matmul (:mod:`.int8`).
+
+The training hook works by *interception*, not graph rewrite: the
+``FullyConnected`` op calls :func:`site_dot` for its matmul.  With no
+context installed that is a plain ``jnp.matmul(x, w.T)`` — bit-identical
+to the pre-quantization op — so the default path carries zero risk.
+``FusedTrainStep`` installs an :class:`FP8Sites` collector around the
+lowered forward; sites are consumed in trace order, which for the
+symbol interpreter equals topo order, so site *i* is the same layer
+every step and its amax history is coherent.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from . import fp8, int8
+from .fp8 import Recipe, scaled_dot
+from .int8 import Int8Weight, int8_matmul, quantize_rowwise
+
+__all__ = ["fp8", "int8", "Recipe", "scaled_dot", "Int8Weight",
+           "int8_matmul", "quantize_rowwise", "FP8Sites",
+           "matmul_context", "site_dot"]
+
+_TLS = threading.local()
+
+
+class FP8Sites:
+    """Trace-time collector for one forward trace: hands each
+    ``FullyConnected`` matmul its per-site amax state in consumption
+    order and accumulates the refreshed states."""
+
+    def __init__(self, states, recipe=None):
+        self.states = tuple(states)
+        self.recipe = recipe or fp8.default_recipe()
+        self.new_states = []
+
+    def dot(self, x, w):
+        i = len(self.new_states)
+        if i >= len(self.states):
+            raise MXNetError(
+                "fp8 matmul context: the forward trace hit more "
+                "FullyConnected sites than the %d planned from the symbol "
+                "graph — the trace is not replay-stable (remat?)"
+                % len(self.states))
+        y, new = scaled_dot(x, w, self.states[i], self.recipe)
+        self.new_states.append(new)
+        return y
+
+
+@contextlib.contextmanager
+def matmul_context(sites: FP8Sites):
+    """Install ``sites`` as the active quantized-matmul context for
+    FullyConnected tracing on this thread."""
+    prev = getattr(_TLS, "sites", None)
+    _TLS.sites = sites
+    try:
+        yield sites
+    finally:
+        _TLS.sites = prev
+
+
+def site_dot(x, w):
+    """The FullyConnected matmul: ``x · wᵀ`` in ``x.dtype``.  Routed
+    through the active quantized context when one is installed;
+    otherwise a plain ``jnp.matmul`` — bit-identical to the
+    pre-quantization op, so the default path is unchanged."""
+    sites = getattr(_TLS, "sites", None)
+    if sites is None:
+        return jnp.matmul(x, w.T)
+    return sites.dot(x, w)

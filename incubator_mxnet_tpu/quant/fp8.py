@@ -1,0 +1,182 @@
+"""fp8 matmul with delayed per-tensor amax scaling (docs/quantization.md).
+
+The recipe is Micikevicius et al., *FP8 Formats for Deep Learning* (2022):
+activations and weights cast to e4m3 in the forward pass, gradients to
+e5m2 in the backward, each with a per-tensor scale derived from a rolling
+window of past amax observations ("delayed scaling" — the scale used at
+step t comes from steps < t, so the cast needs no extra pass over the
+tensor).  Master weights, optimizer state and the loss stay f32; only the
+three matmul operand casts change.
+
+Two dot backends:
+
+- **native** — feed fp8 operands straight to ``lax.dot_general`` with
+  ``preferred_element_type=f32`` (TPU/GPU with fp8 MXU support);
+- **emulation** — upcast the fp8 values to bf16 and dot in bf16/f32.
+  Numerically this applies the SAME value quantization (the fp8 rounding
+  happened at the cast), so convergence behavior is representative on
+  any backend — including the CPU tier-1 mesh — while the speed win is
+  native-only.
+
+``scaled_dot`` is a ``jax.custom_vjp``: its state argument threads the
+amax histories through the step function, and the *backward* pass returns
+the updated gradient history as the state cotangent — the only way a
+quantity first observed during backprop can escape ``jax.vjp``.  Callers
+merge: forward histories from the primal output, gradient history from
+the state cotangent (see ``parallel/fused.py``).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..base import get_env
+
+__all__ = [
+    "E4M3_MAX", "E5M2_MAX", "Recipe", "default_recipe", "native_fp8_dot",
+    "init_site_state", "compute_scale", "saturating_cast", "scaled_dot",
+]
+
+# largest finite values of the two fp8 formats (OCP FP8 spec: e4m3fn has
+# no inf, max=448; e5m2 keeps inf/nan, max finite=57344)
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+E4M3 = jnp.float8_e4m3fn
+E5M2 = jnp.float8_e5m2
+
+
+def native_fp8_dot() -> bool:
+    """Whether to hand fp8 operands to the MXU directly.  ``TP_FP8_NATIVE``
+    forces (1) or forbids (0); default: native on TPU, emulate elsewhere."""
+    ov = get_env("FP8_NATIVE")
+    if ov is not None and str(ov) != "":
+        return str(ov) not in ("0", "false", "False")
+    return jax.default_backend() == "tpu"
+
+
+class Recipe:
+    """Static (trace-time) fp8 configuration: amax-history length,
+    safety margin on the scale, and the dot backend."""
+
+    __slots__ = ("history", "margin", "native")
+
+    def __init__(self, history=None, margin=None, native=None):
+        self.history = int(history if history is not None
+                           else get_env("FP8_HISTORY", 16, int))
+        self.margin = float(margin if margin is not None
+                            else get_env("FP8_MARGIN", 1.0, float))
+        self.native = native_fp8_dot() if native is None else bool(native)
+        if self.history < 1:
+            raise ValueError("fp8 amax history must be >= 1, got %d"
+                             % self.history)
+
+    def __repr__(self):
+        return ("Recipe(history=%d, margin=%g, native=%s)"
+                % (self.history, self.margin, self.native))
+
+
+_DEFAULT = None
+
+
+def default_recipe() -> Recipe:
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = Recipe()
+    return _DEFAULT
+
+
+def init_site_state(recipe: Recipe):
+    """Fresh per-site state: one amax-history vector per operand role.
+    All-zero history ⇒ scale 1.0 ⇒ the first step quantizes unscaled
+    (safe: e4m3 covers ±448, far beyond init-time activations)."""
+    z = jnp.zeros((recipe.history,), jnp.float32)
+    return {"x": z, "w": z, "g": z}
+
+
+def compute_scale(history, fp8_max, margin=1.0):
+    """Delayed scale from the amax window: map the largest recent |value|
+    to ``fp8_max / margin``.  All-zero history (startup) ⇒ 1.0."""
+    amax = jnp.max(history)
+    return jnp.where(amax > 0.0, amax * margin / fp8_max, 1.0)
+
+
+def saturating_cast(x, scale, fp8_max, dtype):
+    """Divide by scale, clip to the format's finite range, then cast.
+    The clip matters: e5m2 overflows to inf and e4m3fn to nan without
+    it, and one stale-history outlier would poison the step."""
+    y = x.astype(jnp.float32) / scale
+    return jnp.clip(y, -fp8_max, fp8_max).astype(dtype)
+
+
+def _roll(history, x):
+    """Record the current tensor's amax at the head of the window."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32))).reshape(1)
+    return jnp.concatenate([amax, history[:-1]])
+
+
+def _qdot(a, b, contract, native):
+    """dot_general over fp8 operands with f32 accumulation; the emulation
+    path upcasts to bf16 first (same quantized values, portable dot)."""
+    if not native:
+        a = a.astype(jnp.bfloat16)
+        b = b.astype(jnp.bfloat16)
+    return jax.lax.dot_general(a, b, dimension_numbers=(contract, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _fwd_impl(recipe, x, w, state):
+    sx = compute_scale(state["x"], E4M3_MAX, recipe.margin)
+    sw = compute_scale(state["w"], E4M3_MAX, recipe.margin)
+    qx = saturating_cast(x, sx, E4M3_MAX, E4M3)
+    qw = saturating_cast(w, sw, E4M3_MAX, E4M3)
+    # FC layout: x (..., K) · w (N, K) → (..., N)
+    y = _qdot(qx, qw, ((x.ndim - 1,), (w.ndim - 1,)), recipe.native)
+    y = (y * (sx * sw)).astype(x.dtype)
+    new_state = {"x": _roll(state["x"], x), "w": _roll(state["w"], w),
+                 "g": state["g"]}
+    return y, new_state, (qx, qw, sx, sw, state["g"])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _scaled_dot(recipe, x, w, state):
+    y, new_state, _ = _fwd_impl(recipe, x, w, state)
+    return y, new_state
+
+
+def _scaled_dot_fwd(recipe, x, w, state):
+    y, new_state, res = _fwd_impl(recipe, x, w, state)
+    # dtype-only sentinels: residuals must be jax types, not np.dtype
+    return (y, new_state), res + (jnp.zeros((), x.dtype),
+                                  jnp.zeros((), w.dtype))
+
+
+def _scaled_dot_bwd(recipe, res, ct):
+    qx, qw, sx, sw, ghist, x_proto, w_proto = res
+    x_dtype, w_dtype = x_proto.dtype, w_proto.dtype
+    gy, _ = ct  # the state cotangent is seeded with zeros by the caller
+    sg = compute_scale(ghist, E5M2_MAX, recipe.margin)
+    qg = saturating_cast(gy, sg, E5M2_MAX, E5M2)
+    # dx (..., K) = gy (..., N) · w (N, K)
+    dx = _qdot(qg, qw, ((qg.ndim - 1,), (0,)), recipe.native) * (sg * sw)
+    # dw (N, K) = Σ_batch gy ⊗ x
+    bd = tuple(range(qx.ndim - 1))
+    dw = _qdot(qg, qx, (bd, bd), recipe.native) * (sg * sx)
+    zeros = jnp.zeros_like(ghist)
+    dstate = {"x": zeros, "w": zeros, "g": _roll(ghist, gy)}
+    return dx.astype(x_dtype), dw.astype(w_dtype), dstate
+
+
+_scaled_dot.defvjp(_scaled_dot_fwd, _scaled_dot_bwd)
+
+
+def scaled_dot(x, w, state, recipe=None):
+    """fp8 ``x · wᵀ`` with delayed per-tensor scaling.
+
+    Returns ``(y, new_state)`` where ``y`` is in ``x.dtype`` and
+    ``new_state`` carries the refreshed x/w amax histories (``g`` passes
+    through — under ``jax.vjp`` the gradient history arrives separately
+    as the cotangent of ``state``)."""
+    return _scaled_dot(recipe or default_recipe(), x, w, state)

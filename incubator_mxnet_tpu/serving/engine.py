@@ -290,14 +290,28 @@ class InferenceEngine:
     @classmethod
     def from_symbol(cls, symbol, arg_params, aux_params,
                     input_shapes: Dict[str, Sequence[int]],
-                    input_dtypes: Optional[Dict] = None, **kw):
+                    input_dtypes: Optional[Dict] = None,
+                    weight_dtype: Optional[str] = None, **kw):
         """Serve a loaded symbol+params pair (the Predictor pair) with
         dynamic batching: ``input_shapes`` are PER-REQUEST shapes (no
         batch axis); the jitted forward retraces per batch bucket, so a
-        mixed-load stream compiles once per bucket."""
+        mixed-load stream compiles once per bucket.
+
+        ``weight_dtype='int8'`` (env ``TP_SERVE_WEIGHT_DTYPE``) parks
+        every 2-D float ``*weight`` parameter as int8 + per-output-
+        channel scale and dequantizes INSIDE the jitted forward — the
+        HBM-resident copy is int8 (docs/quantization.md)."""
         import jax
 
         from ..lowering import lower_symbol
+
+        if weight_dtype is None:
+            weight_dtype = get_env("SERVE_WEIGHT_DTYPE") or None
+        if weight_dtype in ("", "float32", "f32"):
+            weight_dtype = None
+        if weight_dtype not in (None, "int8"):
+            raise MXNetError("weight_dtype must be None or 'int8', "
+                             "got %r" % (weight_dtype,))
 
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
@@ -310,7 +324,7 @@ class InferenceEngine:
         shape_of = dict(zip(arg_names, arg_shapes))
         dtypes = dict(input_dtypes or {})
 
-        def park(src, name, shape):
+        def park(src, name):
             v = (src or {}).get(name)
             if v is None:
                 if "label" in name:
@@ -319,16 +333,34 @@ class InferenceEngine:
             a = np.asarray(v.data if hasattr(v, "data") else v)
             if a.dtype == np.float64:
                 a = a.astype(np.float32)
-            return jax.device_put(a)
+            return a
 
-        params = {n: park(arg_params, n, shape_of[n])
-                  for n in arg_names if n not in input_shapes}
-        aux = {n: park(aux_params, n, s)
-               for n, s in zip(aux_names, aux_shapes)}
-        label_names = [n for n, v in params.items() if v is None]
+        host = {n: park(arg_params, n)
+                for n in arg_names if n not in input_shapes}
+        aux = {n: jax.device_put(park(aux_params, n))
+               for n in aux_names}
+        label_names = [n for n, v in host.items() if v is None]
         label_shape = {n: tuple(shape_of[n][1:]) for n in label_names}
         for n in label_names:
-            del params[n]
+            del host[n]
+
+        params, qparams = {}, {}
+        weight_bytes = 0
+        for n, a in host.items():
+            if (weight_dtype == "int8" and a.ndim == 2
+                    and n.endswith("weight")
+                    and np.issubdtype(a.dtype, np.floating)):
+                from ..quant.int8 import quantize_rowwise
+
+                q, scale = quantize_rowwise(a)
+                qparams[n] = (jax.device_put(q), jax.device_put(scale))
+                weight_bytes += q.nbytes + scale.nbytes
+            else:
+                params[n] = jax.device_put(a)
+                weight_bytes += a.nbytes
+        if weight_dtype == "int8":
+            telemetry.gauge("quant_weight_bytes",
+                            {"component": "engine"}).set(weight_bytes)
 
         fwd = lower_symbol(symbol, is_train=False)
         key = jax.random.PRNGKey(0)
@@ -338,6 +370,10 @@ class InferenceEngine:
             import jax.numpy as jnp
 
             args = dict(params)
+            for n, (q, s) in qparams.items():
+                # dequant inside the compiled program: int8 lives in
+                # HBM, the f32 view exists only transiently
+                args[n] = q.astype(jnp.float32) * s[:, None]
             args.update(inputs)
             b = next(iter(inputs.values())).shape[0]
             for n in label_names:

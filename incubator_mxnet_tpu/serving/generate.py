@@ -110,8 +110,23 @@ def _ln(x, gamma, beta, eps=1e-5):
 def _fc(x, w, b=None):
     import jax.numpy as jnp
 
-    y = jnp.matmul(x, w.T)
+    from ..quant.int8 import Int8Weight, int8_matmul
+
+    if isinstance(w, Int8Weight):
+        # weight-only int8: dequant fused into the matmul epilogue
+        # (docs/quantization.md) — decode reads int8 weight bytes
+        y = int8_matmul(x, w)
+    else:
+        y = jnp.matmul(x, w.T)
     return y if b is None else y + b
+
+
+# transformer_lm weights that feed matmuls (quantizable); the embedding
+# tables are gathers — dequantizing a whole vocab table per step would
+# cost more bytes than it saves
+_EMBED_WEIGHTS = ("tok_embed_weight", "pos_embed_weight")
+
+_KV_DTYPES = ("float32", "bfloat16", "float16")
 
 
 class KVTransformerLM:
@@ -125,16 +140,56 @@ class KVTransformerLM:
     """
 
     def __init__(self, arg_params: Dict, heads: int,
-                 spec: Optional[LMSpec] = None):
+                 spec: Optional[LMSpec] = None, *,
+                 weight_dtype: Optional[str] = None,
+                 kv_dtype: Optional[str] = None):
         import jax
+
+        # int8 weight-only quantization (TP_SERVE_WEIGHT_DTYPE=int8):
+        # matmul weights stored int8 + per-output-channel scale, ONCE at
+        # load; embeddings/norms/biases stay f32 (docs/quantization.md)
+        if weight_dtype is None:
+            weight_dtype = get_env("SERVE_WEIGHT_DTYPE") or None
+        if weight_dtype in ("", "float32", "f32"):
+            weight_dtype = None
+        if weight_dtype not in (None, "int8"):
+            raise MXNetError("weight_dtype must be None or 'int8', "
+                             "got %r" % (weight_dtype,))
+        self.weight_dtype = weight_dtype
+        # KV-cache storage dtype (TP_KV_DTYPE): bf16 halves cache HBM;
+        # attention still accumulates in f32 (reads upcast, writes cast)
+        if kv_dtype is None:
+            kv_dtype = get_env("KV_DTYPE") or None
+        if not kv_dtype:
+            kv_dtype = "float32"
+        if kv_dtype not in _KV_DTYPES:
+            raise MXNetError("kv_dtype must be one of %s, got %r"
+                             % (_KV_DTYPES, kv_dtype))
+        self.kv_dtype = kv_dtype
 
         self.spec = spec or LMSpec.from_params(arg_params, heads)
         self.params = {}
+        weight_bytes = 0
         for n, v in arg_params.items():
             a = np.asarray(v.data if hasattr(v, "data") else v)
             if a.dtype != np.float32:
                 a = a.astype(np.float32)
-            self.params[n] = jax.device_put(a)
+            if (weight_dtype == "int8" and a.ndim == 2
+                    and n.endswith("_weight")
+                    and not n.endswith(_EMBED_WEIGHTS)):
+                from ..quant.int8 import Int8Weight, quantize_rowwise
+
+                q, scale = quantize_rowwise(a)
+                w = Int8Weight(jax.device_put(q), jax.device_put(scale))
+                self.params[n] = w
+                weight_bytes += w.nbytes
+            else:
+                self.params[n] = jax.device_put(a)
+                weight_bytes += a.nbytes
+        # what actually sits in HBM for params — the int8 win shows here
+        self.weight_bytes = weight_bytes
+        telemetry.gauge("quant_weight_bytes",
+                        {"component": "kv_lm"}).set(weight_bytes)
         self.stats = ServeStats()
         self._prefill_fns = {}
         self._decode_fn = None
@@ -154,7 +209,10 @@ class KVTransformerLM:
                 % (max_len, s.max_seq))
         shape = (num_slots + 1, s.num_layers, s.heads, max_len,
                  s.head_dim)
-        return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+        from ..base import dtype_np
+
+        dt = dtype_np(self.kv_dtype)
+        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
     # ------------------------------------------------------------- internals
     def _embed(self, tokens, positions):
@@ -239,8 +297,10 @@ class KVTransformerLM:
             # rows' first L positions
             knew = jnp.stack(ks, axis=1)
             vnew = jnp.stack(vs, axis=1)
-            cache_k = cache_k.at[slots, :, :, :L, :].set(knew)
-            cache_v = cache_v.at[slots, :, :, :L, :].set(vnew)
+            cache_k = cache_k.at[slots, :, :, :L, :].set(
+                knew.astype(cache_k.dtype))
+            cache_v = cache_v.at[slots, :, :, :L, :].set(
+                vnew.astype(cache_v.dtype))
             x = _ln(x, self.params["ln_f_gamma"],
                     self.params["ln_f_beta"])
             last = jnp.take_along_axis(
@@ -296,8 +356,10 @@ class KVTransformerLM:
                 h = _ln(x, self.params["block%d_ln1_gamma" % i],
                         self.params["block%d_ln1_beta" % i])
                 q, k, v = self._qkv(i, h)                  # (slots, H, D)
-                kc = cache_k[:nslots, i]                   # (slots,H,S,D)
-                vc = cache_v[:nslots, i]
+                # reads upcast: attention accumulates in f32 even when
+                # the cache stores bf16 (TP_KV_DTYPE)
+                kc = cache_k[:nslots, i].astype(jnp.float32)
+                vc = cache_v[:nslots, i].astype(jnp.float32)
                 sc = jnp.einsum("nhd,nhkd->nhk", q, kc) * scale
                 sc = jnp.where(mask, sc, neg)
                 s_self = jnp.einsum("nhd,nhd->nh", q, k) * scale
@@ -313,8 +375,10 @@ class KVTransformerLM:
             vnew = jnp.stack(vs, axis=1)
             rows = jnp.arange(nslots)
             pos = jnp.minimum(lengths, S - 1)
-            cache_k = cache_k.at[rows, :, :, pos, :].set(knew)
-            cache_v = cache_v.at[rows, :, :, pos, :].set(vnew)
+            cache_k = cache_k.at[rows, :, :, pos, :].set(
+                knew.astype(cache_k.dtype))
+            cache_v = cache_v.at[rows, :, :, pos, :].set(
+                vnew.astype(cache_v.dtype))
             x = _ln(x, self.params["ln_f_gamma"],
                     self.params["ln_f_beta"])
             return cache_k, cache_v, self._head(x)

@@ -26,8 +26,10 @@ cache), ``executor``/``module`` (step latency, samples/sec, epochs),
 ``parallel.collectives`` (invocations by kind + payload bytes),
 ``parallel.zero`` (``optimizer_state_bytes_total`` /
 ``optimizer_state_bytes_per_device`` gauges labeled by train-step
-scope — the ZeRO-1 footprint signal), and device memory via
-``jax.local_devices()[*].memory_stats()``.
+scope — the ZeRO-1 footprint signal), ``quant`` + its call sites
+(``quant_weight_bytes`` per serving component, ``quant_scale`` per fp8
+site/role, ``quant_amax_rescales_total`` — docs/quantization.md), and
+device memory via ``jax.local_devices()[*].memory_stats()``.
 
 Env controls::
 

@@ -87,6 +87,50 @@ def test_predictor_input_dtypes(tmp_path):
                   input_dtypes={"bogus": np.int32})
 
 
+def test_predictor_int8_input_declaration():
+    """Quantized checkpoints declare int8 inputs: the declared dtype
+    always wins over the default staging map, and undeclared integer
+    inputs stay integral (64-bit narrows to 32) instead of detouring
+    through f32."""
+    from incubator_mxnet_tpu.models import transformer
+
+    net = transformer.get_symbol(vocab_size=11, embed=8, heads=2,
+                                 num_layers=1, seq_len=6, batch_size=2,
+                                 head="softmax")
+    arg_names = net.list_arguments()
+    arg_shapes, _, _ = net.infer_shape(data=(2, 6),
+                                       softmax_label=(2, 6))
+    rng = np.random.RandomState(5)
+    params = {n: rng.randn(*s).astype(np.float32) * 0.1
+              for n, s in zip(arg_names, arg_shapes)
+              if n not in ("data", "softmax_label")}
+    shapes = {"data": (2, 6), "softmax_label": (2, 6)}
+    toks = rng.randint(0, 11, size=(2, 6))  # int64 on linux
+    zeros = np.zeros((2, 6), np.float32)
+
+    # explicit int8 declaration reaches the graph untouched, even when
+    # the caller stages float64 — declared dtype beats the default map
+    p8 = Predictor(net, params, {}, shapes,
+                   input_dtypes={"data": np.int8})
+    p8.set_input(data=toks.astype(np.float64), softmax_label=zeros)
+    assert p8._inputs["data"].dtype == np.int8
+    p8.forward()
+    out8 = p8.get_output(0)
+
+    # undeclared: int64 tokens narrow to int32, bools stay bool
+    pd = Predictor(net, params, {}, shapes)
+    pd.set_input(data=toks, softmax_label=zeros)
+    assert pd._inputs["data"].dtype == np.int32
+    assert np.asarray(
+        pd._inputs["data"]).tolist() == toks.tolist()
+    pd.forward()
+    np.testing.assert_allclose(out8, pd.get_output(0),
+                               rtol=1e-6, atol=1e-7)
+    b = np.zeros((2, 6), np.bool_)
+    pd.set_input(data=b, softmax_label=zeros)
+    assert pd._inputs["data"].dtype == np.bool_
+
+
 def test_predictor_validation(tmp_path):
     _, _, _, prefix = _train_and_checkpoint(tmp_path)
     p = Predictor.load(prefix + "-symbol.json", prefix + "-0003.params",

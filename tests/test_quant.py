@@ -1,0 +1,498 @@
+"""Quantized compute paths (docs/quantization.md): int8 weight-only
+serving decode + fp8 delayed-scaling matmul training.
+
+Tolerances encode measured behavior on the tiny fixtures: fp8 e4m3
+rounds to ~2^-3 relative (observed ≤4% on randn matmuls), int8 per-row
+weights perturb tiny-LM logits by ≤5e-2 while greedy argmax chains stay
+token-exact, bf16 KV caches move logits ≤1e-2."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import parallel, quant, telemetry
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.quant import fp8, int8
+from incubator_mxnet_tpu.serving import (GenerationEngine,
+                                         InferenceEngine,
+                                         KVTransformerLM)
+
+# ------------------------------------------------- int8 building blocks
+
+
+def test_int8_roundtrip_invariants():
+    """Per-row symmetric quantization: zero rows exact, constant rows
+    exact, outliers saturate only their own row, error ≤ half a step."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 16).astype(np.float32)
+    w[2] = 0.0          # all-zero row: scale 1, q 0, exact
+    w[3] = 0.25         # constant row: amax maps to ±127 exactly
+    w[4, 7] = 50.0      # outlier: widens row 4's step, nobody else's
+    q, scale = int8.quantize_rowwise(w)
+    assert q.dtype == np.int8 and scale.dtype == np.float32
+    assert q.shape == w.shape and scale.shape == (8,)
+    # symmetric range use: every row's amax lands on ±127
+    assert all(np.abs(q[i]).max() == 127 for i in range(8) if i != 2)
+    assert (q[2] == 0).all() and scale[2] == 1.0
+    back = int8.dequantize_rowwise(q, scale)
+    np.testing.assert_array_equal(back[3], w[3])
+    # error bound: half a quantization step, per row
+    assert (np.abs(back - w) <= scale[:, None] * 0.5 + 1e-7).all()
+    # row 4's step is outlier-wide; row 5's is not
+    assert scale[4] > 10 * scale[5]
+    with pytest.raises(ValueError, match="2-D"):
+        int8.quantize_rowwise(np.zeros((2, 3, 4), np.float32))
+
+
+def test_int8_weight_matmul_matches_dequantized_reference():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    w = rng.randn(6, 12).astype(np.float32)
+    x = rng.randn(4, 12).astype(np.float32)
+    q, scale = int8.quantize_rowwise(w)
+    iw = int8.Int8Weight(jnp.asarray(q), jnp.asarray(scale))
+    assert iw.shape == (6, 12)
+    assert iw.nbytes == 6 * 12 + 4 * 6  # int8 payload + f32 scales
+    y = np.asarray(int8.int8_matmul(jnp.asarray(x), iw))
+    ref = x @ int8.dequantize_rowwise(q, scale).T
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(iw.dequantize()),
+                               int8.dequantize_rowwise(q, scale),
+                               rtol=1e-6, atol=1e-7)
+
+
+# -------------------------------------------------- fp8 building blocks
+
+
+def test_fp8_scale_and_saturating_cast():
+    import jax.numpy as jnp
+
+    # all-zero history (startup) ⇒ scale 1.0
+    z = jnp.zeros((4,), jnp.float32)
+    assert float(fp8.compute_scale(z, fp8.E4M3_MAX)) == 1.0
+    h = z.at[1].set(896.0)  # amax anywhere in the window counts
+    assert float(fp8.compute_scale(h, fp8.E4M3_MAX)) \
+        == pytest.approx(2.0)
+    assert float(fp8.compute_scale(h, fp8.E4M3_MAX, margin=2.0)) \
+        == pytest.approx(4.0)
+    # saturation: out-of-range values clip to the max FINITE value —
+    # e4m3fn would round to nan, e5m2 to inf without the clip
+    big = jnp.asarray([1e6, -1e6, 0.0, 1.0], jnp.float32)
+    one = jnp.asarray(1.0)
+    e4 = np.asarray(fp8.saturating_cast(big, one, fp8.E4M3_MAX,
+                                        fp8.E4M3).astype(jnp.float32))
+    assert np.isfinite(e4).all()
+    assert e4[0] == fp8.E4M3_MAX and e4[1] == -fp8.E4M3_MAX
+    assert e4[2] == 0.0 and e4[3] == 1.0
+    e5 = np.asarray(fp8.saturating_cast(big, one, fp8.E5M2_MAX,
+                                        fp8.E5M2).astype(jnp.float32))
+    assert np.isfinite(e5).all() and e5[0] == fp8.E5M2_MAX
+    with pytest.raises(ValueError, match="history"):
+        fp8.Recipe(history=0)
+
+
+def test_scaled_dot_forward_backward_parity_and_state():
+    """fp8 scaled_dot tracks the f32 matmul within e4m3/e5m2 rounding
+    and records the operands' amax at the head of the history."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    w = jnp.asarray(rng.randn(4, 16).astype(np.float32))
+    rec = fp8.Recipe(history=4, native=False)
+    st = fp8.init_site_state(rec)
+
+    def f(x, w):
+        y, ns = fp8.scaled_dot(x, w, st, rec)
+        return jnp.sum(y * y), (y, ns)
+
+    (_, (y, ns)), (dx, dw) = jax.value_and_grad(
+        f, argnums=(0, 1), has_aux=True)(x, w)
+    ref = np.asarray(x @ w.T)
+
+    def rel(a, b):
+        return np.abs(np.asarray(a) - np.asarray(b)).max() \
+            / np.abs(np.asarray(b)).max()
+
+    assert rel(y, ref) < 0.08  # e4m3 rounding, observed ~4%
+
+    def g(x, w):
+        return jnp.sum((x @ w.T) ** 2)
+
+    gx, gw = jax.grad(g, argnums=(0, 1))(x, w)
+    # e5m2 keeps 2 mantissa bits ⇒ up to ~12.5% per-element rounding
+    # on the incoming gradient; observed max ≈ 13.5% on this fixture
+    assert rel(dx, gx) < 0.2 and rel(dw, gw) < 0.2
+    # forward histories roll the fresh amax in at index 0
+    assert np.asarray(ns["x"])[0] == pytest.approx(
+        float(jnp.abs(x).max()), rel=1e-6)
+    assert np.asarray(ns["w"])[0] == pytest.approx(
+        float(jnp.abs(w).max()), rel=1e-6)
+    # g passes through the primal (it arrives via the state cotangent)
+    np.testing.assert_array_equal(np.asarray(ns["g"]),
+                                  np.asarray(st["g"]))
+    # second application under jit agrees with eager
+    y2, _ = jax.jit(lambda a, b: fp8.scaled_dot(a, b, st, rec))(x, w)
+    np.testing.assert_array_equal(np.asarray(y2), np.asarray(y))
+
+
+def test_site_dot_default_is_bit_exact_plain_matmul():
+    """With no context installed the FullyConnected hook is bit-identical
+    to jnp.matmul(x, w.T) — the TP_MATMUL_DTYPE-unset contract."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(5, 7).astype(np.float32))
+    w = jnp.asarray(rng.randn(3, 7).astype(np.float32))
+    y = quant.site_dot(x, w)
+    assert (np.asarray(y) == np.asarray(jnp.matmul(x, w.T))).all()
+
+
+def test_matmul_context_consumes_sites_in_order():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(2, 8).astype(np.float32))
+    w = jnp.asarray(rng.randn(3, 8).astype(np.float32))
+    rec = fp8.Recipe(history=2, native=False)
+    states = tuple(fp8.init_site_state(rec) for _ in range(2))
+    col = quant.FP8Sites(states, rec)
+    with quant.matmul_context(col):
+        quant.site_dot(x, w)
+        quant.site_dot(2.0 * x, w)
+    assert len(col.new_states) == 2
+    # per-site histories saw their own operands
+    assert np.asarray(col.new_states[0]["x"])[0] == pytest.approx(
+        float(jnp.abs(x).max()), rel=1e-6)
+    assert np.asarray(col.new_states[1]["x"])[0] == pytest.approx(
+        2.0 * float(jnp.abs(x).max()), rel=1e-6)
+    # one site too many: the trace is not replay-stable
+    col2 = quant.FP8Sites(states[:1], rec)
+    with quant.matmul_context(col2):
+        quant.site_dot(x, w)
+        with pytest.raises(MXNetError, match="planned"):
+            quant.site_dot(x, w)
+    # context restored: back to the plain bit-exact matmul
+    assert (np.asarray(quant.site_dot(x, w))
+            == np.asarray(jnp.matmul(x, w.T))).all()
+
+
+# ------------------------------------------------ FusedTrainStep + fp8
+
+
+def _mlp():
+    d = mx.sym.Variable("data")
+    x = mx.sym.FullyConnected(d, num_hidden=16, name="fc1")
+    x = mx.sym.Activation(x, act_type="relu", name="r1")
+    x = mx.sym.FullyConnected(x, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(x, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def _fused(net, mdt, accum=1, **kw):
+    return parallel.FusedTrainStep(
+        net, {"data": (16, 8)}, {"softmax_label": (16,)},
+        mesh=parallel.default_mesh(1), optimizer="adam",
+        optimizer_params={"learning_rate": 0.01},
+        initializer=mx.initializer.Xavier(), seed=0,
+        matmul_dtype=mdt, grad_accum=accum, **kw)
+
+
+def test_fused_fp8_validation_and_env_knob(monkeypatch):
+    net = _mlp()
+    with pytest.raises(MXNetError, match="matmul_dtype"):
+        _fused(net, "int4")
+    with pytest.raises(MXNetError, match="remat"):
+        _fused(net, "fp8", remat="mirror")
+    # a graph with no FullyConnected has nothing to quantize
+    conv = mx.sym.Convolution(mx.sym.Variable("data"), num_filter=2,
+                              kernel=(3, 3), name="c1")
+    conv = mx.sym.SoftmaxOutput(
+        mx.sym.Flatten(mx.sym.Pooling(conv, kernel=(26, 26),
+                                      pool_type="avg", name="p1")),
+        mx.sym.Variable("softmax_label"), name="softmax")
+    with pytest.raises(MXNetError, match="FullyConnected"):
+        parallel.FusedTrainStep(
+            conv, {"data": (4, 1, 28, 28)}, {"softmax_label": (4,)},
+            mesh=parallel.default_mesh(1),
+            initializer=mx.initializer.Xavier(), seed=0,
+            matmul_dtype="fp8")
+    # env knob applies only when the caller did not specify
+    monkeypatch.setenv("TP_MATMUL_DTYPE", "fp8")
+    step = _fused(net, None)
+    assert step._matmul_dtype == "fp8"
+    assert len(step.quant_state) == 2  # one per FC site
+    monkeypatch.setenv("TP_MATMUL_DTYPE", "float32")
+    step32 = _fused(net, None)
+    assert step32._matmul_dtype is None
+    assert step32.quant_state == ()
+    assert step32.quant_info() is None
+
+
+def test_fused_fp8_converges_within_envelope():
+    """§21b-style A/B gate on the MLP: fp8 training (with and without
+    grad accumulation) must land inside a small envelope of the f32
+    run after 20 adam steps."""
+    net = _mlp()
+    rng = np.random.RandomState(0)
+    data = rng.randn(16, 8).astype(np.float32)
+    labels = rng.randint(0, 4, (16,)).astype(np.float32)
+    runs = {}
+    for mdt, accum in ((None, 1), ("fp8", 1), ("fp8", 4)):
+        mx.random.seed(1)
+        step = _fused(net, mdt, accum)
+        for _ in range(20):
+            outs = step({"data": data, "softmax_label": labels})
+        probs = np.asarray(outs[0])
+        nll = -np.log(probs[np.arange(16), labels.astype(int)] + 1e-9)
+        runs[(mdt, accum)] = nll.mean()
+    base = runs[(None, 1)]
+    assert runs[("fp8", 1)] < 1.2 * base + 0.05, runs
+    assert runs[("fp8", 4)] < 1.3 * base + 0.1, runs
+
+
+def test_fused_fp8_quant_info_tracks_scales(tmp_path):
+    telemetry.disable()
+    telemetry.enable(str(tmp_path / "t.jsonl"))
+    try:
+        net = _mlp()
+        rng = np.random.RandomState(5)
+        batch = {"data": rng.randn(16, 8).astype(np.float32),
+                 "softmax_label":
+                     rng.randint(0, 4, (16,)).astype(np.float32)}
+        mx.random.seed(1)
+        step = _fused(net, "fp8")
+        info0 = step.quant_info()
+        assert [s["site"] for s in info0["sites"]] == [0, 1]
+        # pre-step: all-zero histories ⇒ scale 1.0 everywhere
+        assert all(s[r]["scale"] == 1.0
+                   for s in info0["sites"] for r in ("x", "w", "g"))
+        step(batch)
+        info1 = step.quant_info()
+        for s in info1["sites"]:
+            assert s["x"]["amax"] > 0.0 and s["w"]["amax"] > 0.0
+            # the backward ran: gradient amax came back via the
+            # state cotangent, not the forward primal
+            assert s["g"]["amax"] > 0.0
+        assert "history=" in info1["recipe"]
+        moved = telemetry.counter("quant_amax_rescales_total").value
+        assert moved >= 1
+    finally:
+        telemetry.disable()
+
+
+@pytest.mark.slow
+def test_fp8_shift_task_ab_gate():
+    """The ISSUE's A/B convergence gate: a 1-layer transformer LM on the
+    shift task (next token = token+1 mod V), f32 vs fp8 matmuls, same
+    seeds — fp8 must fit the task inside the §21b envelope.  Marked slow
+    but CI-enforced: tools/check.py runs it by id."""
+    from incubator_mxnet_tpu.models import transformer
+
+    V, B, S = 13, 8, 12
+    net = transformer.get_symbol(vocab_size=V, embed=16, heads=2,
+                                 num_layers=1, seq_len=S, batch_size=B,
+                                 head="softmax")
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, V, size=(B, S)).astype(np.float32)
+    labels = ((data + 1) % V).astype(np.float32)
+    losses = {}
+    for mdt in (None, "fp8"):
+        mx.random.seed(2)
+        step = parallel.FusedTrainStep(
+            net, {"data": (B, S)}, {"softmax_label": (B, S)},
+            mesh=parallel.default_mesh(1), optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.initializer.Xavier(), seed=0,
+            matmul_dtype=mdt)
+        for _ in range(30):
+            outs = step({"data": data, "softmax_label": labels})
+        probs = np.asarray(outs[0]).reshape(B, S, V)
+        lab = labels.astype(int)
+        nll = -np.log(probs[np.arange(B)[:, None],
+                            np.arange(S)[None, :], lab] + 1e-9)
+        losses[mdt] = nll.mean()
+    assert losses["fp8"] < 1.2 * losses[None] + 0.05, losses
+
+
+# ------------------------------------------------- int8 serving decode
+
+V, E, H, NL, S = 13, 16, 4, 2, 32
+
+
+def _tiny_params(seed=0, vocab=V, embed=E, layers=NL, max_seq=S):
+    rng = np.random.RandomState(seed)
+
+    def mk(*shape):
+        return rng.randn(*shape).astype(np.float32) * 0.1
+
+    p = {"tok_embed_weight": mk(vocab, embed),
+         "pos_embed_weight": mk(max_seq, embed),
+         "ln_f_gamma": np.ones(embed, np.float32),
+         "ln_f_beta": mk(embed),
+         "lm_head_weight": mk(vocab, embed),
+         "lm_head_bias": mk(vocab)}
+    for i in range(layers):
+        p.update({
+            "block%d_ln1_gamma" % i: np.ones(embed, np.float32),
+            "block%d_ln1_beta" % i: mk(embed),
+            "block%d_q_weight" % i: mk(embed, embed),
+            "block%d_k_weight" % i: mk(embed, embed),
+            "block%d_v_weight" % i: mk(embed, embed),
+            "block%d_attn_proj_weight" % i: mk(embed, embed),
+            "block%d_attn_proj_bias" % i: mk(embed),
+            "block%d_ln2_gamma" % i: np.ones(embed, np.float32),
+            "block%d_ln2_beta" % i: mk(embed),
+            "block%d_ffn1_weight" % i: mk(4 * embed, embed),
+            "block%d_ffn1_bias" % i: mk(4 * embed),
+            "block%d_ffn2_weight" % i: mk(embed, 4 * embed),
+            "block%d_ffn2_bias" % i: mk(embed),
+        })
+    return p
+
+
+def test_serving_int8_weight_bytes_and_logit_parity(monkeypatch):
+    """int8 weight-only: matmul weights shrink ~4×, embeddings stay f32;
+    logits track the f32 model within the documented 5e-2 and the
+    greedy argmax chain is token-exact on the tiny LM."""
+    params = _tiny_params()
+    base = KVTransformerLM(params, heads=H)
+    q8 = KVTransformerLM(params, heads=H, weight_dtype="int8")
+    assert q8.weight_dtype == "int8"
+    # all matmul weights int8 + f32 scale, embeddings untouched
+    assert q8.weight_bytes < 0.45 * base.weight_bytes
+    from incubator_mxnet_tpu.quant.int8 import Int8Weight
+
+    assert isinstance(q8.params["block0_q_weight"], Int8Weight)
+    assert not isinstance(q8.params["tok_embed_weight"], Int8Weight)
+
+    rng = np.random.RandomState(6)
+    seq = rng.randint(0, V, size=(10,)).astype(np.int32)
+    lb = np.asarray(base.full_logits(seq))
+    lq = np.asarray(q8.full_logits(seq))
+    np.testing.assert_allclose(lq, lb, atol=5e-2, rtol=0)
+    assert (lb.argmax(-1) == lq.argmax(-1)).all()
+
+    # env knob + validation
+    monkeypatch.setenv("TP_SERVE_WEIGHT_DTYPE", "int8")
+    assert KVTransformerLM(params, heads=H).weight_dtype == "int8"
+    monkeypatch.setenv("TP_SERVE_WEIGHT_DTYPE", "float32")
+    assert KVTransformerLM(params, heads=H).weight_dtype is None
+    with pytest.raises(MXNetError, match="weight_dtype"):
+        KVTransformerLM(params, heads=H, weight_dtype="int4")
+
+
+def test_kv_cache_bf16_parity():
+    """TP_KV_DTYPE=bfloat16 halves the cache; reads upcast so attention
+    still accumulates f32 — decode tokens stay greedy-exact and logits
+    within 1e-2 on the tiny LM."""
+    import jax.numpy as jnp
+
+    params = _tiny_params()
+    f32 = KVTransformerLM(params, heads=H)
+    half = KVTransformerLM(params, heads=H, kv_dtype="bfloat16")
+    ck16, cv16 = half.init_cache(2, S)
+    assert ck16.dtype == jnp.bfloat16 and cv16.dtype == jnp.bfloat16
+
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, V, size=5).astype(np.int32)
+    outs = {}
+    for name, m in (("f32", f32), ("bf16", half)):
+        ck, cv = m.init_cache(2, S)
+        toks = np.zeros((1, 8), np.int32)
+        toks[0, :5] = prompt
+        ck, cv, last = m.prefill(ck, cv, toks,
+                                 np.array([5]), np.array([0]))
+        lengths = np.array([5, 0], np.int32)
+        tok = int(np.argmax(np.asarray(last)[0]))
+        logits, chain = [np.asarray(last)[0]], [tok]
+        for _ in range(6):
+            ck, cv, lg = m.decode(ck, cv,
+                                  np.array([tok, 0], np.int32), lengths)
+            lengths[0] += 1
+            tok = int(np.argmax(np.asarray(lg)[0]))
+            logits.append(np.asarray(lg)[0])
+            chain.append(tok)
+        outs[name] = (chain, np.stack(logits))
+    assert outs["f32"][0] == outs["bf16"][0]  # token-exact
+    np.testing.assert_allclose(outs["bf16"][1], outs["f32"][1],
+                               atol=1e-2, rtol=0)
+    with pytest.raises(MXNetError, match="kv_dtype"):
+        KVTransformerLM(params, heads=H, kv_dtype="fp4")
+
+
+@pytest.mark.slow
+def test_generation_engine_int8_greedy_parity(tmp_path):
+    """End-to-end through GenerationEngine: int8 weights generate the
+    same greedy tokens as f32, and the (bucket, phase) compile bound
+    holds — the serve-compile telemetry counter agrees.  Marked slow
+    but CI-enforced: tools/check.py runs it by id."""
+    telemetry.disable()
+    telemetry.enable(str(tmp_path / "t.jsonl"))
+    try:
+        params = _tiny_params()
+        rng = np.random.RandomState(8)
+        prompts = [rng.randint(0, V, size=n).astype(np.int32)
+                   for n in (3, 5, 2, 7)]
+        outs = {}
+
+        def compiles_counted():
+            return sum(telemetry.counter("serve_compiles_total",
+                                         {"phase": ph}).value
+                       for ph in ("prefill", "decode", "sample"))
+
+        for name, wdt in (("f32", None), ("int8", "int8")):
+            m = KVTransformerLM(params, heads=H, weight_dtype=wdt)
+            before = compiles_counted()
+            with GenerationEngine(m, max_slots=2, max_len=S) as eng:
+                futs = [eng.submit(p, max_new_tokens=4)
+                        for p in prompts]
+                outs[name] = [f.result(timeout=120).tokens.tolist()
+                              for f in futs]
+            if wdt == "int8":
+                # quantization must not break the compile bound: one
+                # decode program, one sampler, bucketed prefill
+                keys = m.stats.compile_keys
+                assert len({k for k in keys if k[0] == "decode"}) == 1
+                assert len({k for k in keys if k[0] == "sample"}) == 1
+                # counter delta for THIS model (the registry is global)
+                assert compiles_counted() - before \
+                    == m.stats.num_compiles
+                assert telemetry.gauge(
+                    "quant_weight_bytes",
+                    {"component": "kv_lm"}).value == m.weight_bytes
+        assert outs["f32"] == outs["int8"]
+    finally:
+        telemetry.disable()
+
+
+def test_inference_engine_from_symbol_int8():
+    """The generic serving path: from_symbol parks 2-D weights as int8
+    and dequantizes inside the jitted forward; softmax outputs track
+    the f32 engine closely on a trained-ish MLP."""
+    net = mx.models.mlp(num_classes=5)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 1, 28, 28))],
+             label_shapes=[("softmax_label", (8,))])
+    mx.random.seed(0)
+    mod.init_params(mx.initializer.Xavier())
+    arg_params, aux_params = mod.get_params()
+    rng = np.random.RandomState(9)
+    xs = [rng.rand(1, 28, 28).astype(np.float32) for _ in range(3)]
+    outs = {}
+    for name, wdt in (("f32", None), ("int8", "int8")):
+        with InferenceEngine.from_symbol(
+                net, arg_params, aux_params, {"data": (1, 28, 28)},
+                weight_dtype=wdt, max_batch=4,
+                max_delay_ms=10.0) as eng:
+            futs = [eng.submit({"data": x}) for x in xs]
+            outs[name] = [np.asarray(f.result(timeout=60)[0])
+                          for f in futs]
+    for a, b in zip(outs["int8"], outs["f32"]):
+        np.testing.assert_allclose(a, b, atol=2e-2, rtol=0)
+        assert a.argmax(-1) == b.argmax(-1)
+    with pytest.raises(MXNetError, match="weight_dtype"):
+        InferenceEngine.from_symbol(net, arg_params, aux_params,
+                                    {"data": (1, 28, 28)},
+                                    weight_dtype="int4")

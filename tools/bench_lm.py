@@ -12,6 +12,7 @@ Env: TP_LM_BATCH (8), TP_LM_SEQ (2048), TP_LM_EMBED (512),
 TP_LM_LAYERS (4), TP_LM_VOCAB (32000), TP_LM_STEPS (10),
 TP_LM_DTYPE (bfloat16), TP_LM_HEAD (fused|softmax),
 TP_LM_OPT_DTYPE / TP_LM_GRAD_DTYPE (bf16 opt-ins, PERF.md §21b),
+TP_LM_MATMUL_DTYPE (fp8 delayed-scaling matmuls, docs/quantization.md),
 TP_LM_MOE (experts per layer, 0 = dense) / TP_LM_MOE_TOPK (2) /
 TP_LM_MOE_CAP (1.25) — the MoE model family (PERF.md §8e),
 TP_LM_DP (1: data-parallel mesh size) and TP_LM_SHARD_OPT=1
@@ -120,6 +121,7 @@ def run(defaults=None):
         optimizer_params={"learning_rate": 1e-3},
         opt_state_dtype=cfg("TP_LM_OPT_DTYPE", "") or None,
         grad_dtype=cfg("TP_LM_GRAD_DTYPE", "") or None,
+        matmul_dtype=cfg("TP_LM_MATMUL_DTYPE", "") or None,
         initializer=mx.initializer.Xavier(),
         shard_optimizer=shard_opt)
     _, opt_bytes_dev = step.optimizer_state_bytes()
@@ -165,6 +167,7 @@ def run(defaults=None):
         # states what ACTUALLY ran (a "tuned" label alone could lie)
         "opt_state_dtype": cfg("TP_LM_OPT_DTYPE", "") or "float32",
         "grad_dtype": cfg("TP_LM_GRAD_DTYPE", "") or "float32",
+        "matmul_dtype": cfg("TP_LM_MATMUL_DTYPE", "") or "float32",
         "mesh_dp": ndp, "shard_optimizer": shard_opt,
         "opt_state_bytes_per_device": int(opt_bytes_dev),
         "model_tflops_per_sec": round(tflops, 1),

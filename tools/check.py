@@ -21,7 +21,10 @@ Checks, in order:
    ``TP_CHECK_SCHEDULE=0``);
 5. **serving** — the serving smoke subset (``TP_CHECK_SERVE=0`` skips);
 6. **overlap** — the overlapped-train-loop bit-equality subset
-   (``tests/test_overlap.py``; ``TP_CHECK_OVERLAP=0`` skips).
+   (``tests/test_overlap.py``; ``TP_CHECK_OVERLAP=0`` skips);
+7. **quant** — the quantized-path subset: int8 serving parity, the
+   fp8 shift-task A/B gate and the default-path bit-exactness
+   (``tests/test_quant.py``; ``TP_CHECK_QUANT=0`` skips).
 
 Exit code 0 = clean; 1 = findings (printed one per line).
 """
@@ -215,6 +218,39 @@ def check_overlap(problems):
                         + "\n  ".join(tail))
 
 
+def check_quant(problems):
+    """Quantized-path gate (docs/quantization.md): the int8 serving
+    parity oracle (greedy tokens vs f32 end to end), the fp8 shift-task
+    A/B convergence envelope, and the contract that the default path
+    stays a plain bit-exact matmul.  The heavy tests here carry
+    ``@pytest.mark.slow`` so the tier-1 sweep skips them; this gate
+    runs them by id (needs jax — skip with ``TP_CHECK_QUANT=0``)."""
+    if os.environ.get("TP_CHECK_QUANT", "1") == "0":
+        return
+    import subprocess
+
+    tests = "tests/test_quant.py"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q",
+             "-p", "no:cacheprovider", "-p", "no:randomly",
+             tests + "::test_site_dot_default_is_bit_exact_plain_matmul",
+             tests + "::test_int8_roundtrip_invariants",
+             tests + "::test_serving_int8_weight_bytes_and_logit_parity",
+             tests + "::test_fp8_shift_task_ab_gate",
+             tests + "::test_generation_engine_int8_greedy_parity"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        problems.append("quant: gate run did not finish: %s" % e)
+        return
+    if proc.returncode != 0:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-12:]
+        problems.append("quant: quantized-path gate failed:\n  "
+                        + "\n  ".join(tail))
+
+
 def main():
     problems = []
     check_compile(problems)
@@ -223,6 +259,7 @@ def main():
     check_schedule(problems)
     check_serving(problems)
     check_overlap(problems)
+    check_quant(problems)
     for p in problems:
         print(p)
     print("%d file(s) checked, %d problem(s)"
