@@ -342,6 +342,103 @@ def _quantization_record(small):
     return record
 
 
+def _resilience_record(small):
+    """Resilience sub-record (docs/fault_tolerance.md): the same fused
+    train step timed with checkpointing off, with the async
+    CheckpointManager (the train loop pays only the fence + the
+    device→host snapshot; persistence runs on the writer thread) and
+    with sync saves — the async design target is <5% per-step overhead
+    — plus the measured checkpoint save and restore wall times."""
+    import shutil
+    import tempfile
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.resilience import CheckpointManager
+
+    # non-small cadence matches the TP_CKPT_EVERY default (100): the
+    # per-save cost (fence + snapshot on the train thread) amortizes
+    # over the interval, which is what the <5% overhead target is about
+    dim, hidden, batch = (32, 64, 32) if small else (256, 1024, 256)
+    steps = 12 if small else 200
+    every = 3 if small else 100
+    repeats = 2 if small else 3
+
+    mx.random.seed(0)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=hidden)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=10)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    step = parallel.FusedTrainStep(
+        net, {"data": (batch, dim)}, {"softmax_label": (batch,)},
+        mesh=parallel.default_mesh(1), optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    bd = {"data": rng.randn(batch, dim).astype(np.float32),
+          "softmax_label": rng.randint(0, 10, batch).astype(np.float32)}
+    step(bd)
+    step.sync()  # compile + drain before any timed region
+
+    counter = [0]  # global step keeps advancing across variants, so
+    # every run hits the same steps/every save cadence
+
+    def run(cm):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            counter[0] += 1
+            step(bd)
+            if cm is not None:
+                cm.step_end(step, counter[0])
+        step.sync()  # readback fence on the final parameter update
+        return time.perf_counter() - t0
+
+    tmp = tempfile.mkdtemp(prefix="tp_bench_resilience_")
+    try:
+        acm = CheckpointManager(os.path.join(tmp, "async"),
+                                every_n_steps=every, keep_last=2,
+                                async_save=True)
+        scm = CheckpointManager(os.path.join(tmp, "sync"),
+                                every_n_steps=every, keep_last=2,
+                                async_save=False)
+        # one warmup save so the timed runs measure the steady state,
+        # not writer-thread spin-up or first-serialization setup
+        acm.save(step, counter[0], sync=True)
+        # interleave the variants per repeat (min of each) so slow
+        # machine-level drift hits all three equally
+        base_dt = async_dt = sync_dt = float("inf")
+        for _ in range(repeats):
+            base_dt = min(base_dt, run(None))
+            async_dt = min(async_dt, run(acm))
+            sync_dt = min(sync_dt, run(scm))
+        acm.wait()
+        saves_async = acm.saves_completed
+        acm.close()
+        save_s = scm.last_save_seconds
+        scm.close()
+        rcm = CheckpointManager(os.path.join(tmp, "sync"),
+                                async_save=False)
+        restored = rcm.restore_latest(step)
+        restore_s = rcm.last_restore_seconds
+        rcm.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "metric": "resilience_async_ckpt_step_overhead",
+        "value": round(async_dt / base_dt - 1.0, 4),
+        "unit": "fraction_vs_nockpt",
+        "steps": steps, "every_n_steps": every, "batch": batch,
+        "step_ms_nockpt": round(base_dt / steps * 1e3, 3),
+        "step_ms_async_ckpt": round(async_dt / steps * 1e3, 3),
+        "step_ms_sync_ckpt": round(sync_dt / steps * 1e3, 3),
+        "sync_ckpt_step_overhead": round(sync_dt / base_dt - 1.0, 4),
+        "async_saves_completed": saves_async,
+        "save_wall_seconds": round(save_s, 4),
+        "restore_wall_seconds": round(restore_s, 4),
+        "restored_step": restored["step"] if restored else None,
+    }
+
+
 def _input_pipeline_record(small):
     """Input-pipeline A/B (docs/input_pipeline.md): the same Module.fit
     run with the overlapped loop OFF (TP_MAX_INFLIGHT=0, host iterator,
@@ -513,6 +610,11 @@ def main():
     combined["quantization"]["fp8_train"] = {
         k: fp8_lm[k] for k in ("value", "model_tflops_per_sec",
                                "mfu_vs_sustained", "matmul_dtype")}
+    # resilience sub-record (docs/fault_tolerance.md): per-step cost of
+    # async vs sync checkpointing against the no-checkpoint baseline,
+    # plus save/restore wall time — the <5% async-overhead claim is
+    # driver-verifiable here, not prose
+    combined["resilience"] = _resilience_record(small)
     # input-pipeline A/B (docs/input_pipeline.md): Module.fit with the
     # overlapped loop off vs on — img/s, starvation fraction, and the
     # metric-readback counts (O(steps) vs O(steps/window))

@@ -80,6 +80,7 @@ _OPTIONAL = [
     ("rnn", ()), ("image", ()), ("operator", ()), ("rtc", ()),
     ("contrib", ()), ("log", ()), ("libinfo", ()), ("torch", ()),
     ("predictor", ()), ("serving", ()), ("quant", ()),
+    ("resilience", ()),
 ]
 
 import importlib as _importlib

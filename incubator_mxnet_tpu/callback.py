@@ -65,7 +65,13 @@ class Speedometer:
 
 
 def do_checkpoint(prefix: str, period: int = 1):
-    """Epoch checkpoint callback (reference ``callback.py:55``)."""
+    """Epoch checkpoint callback (reference ``callback.py:55``).
+
+    Files land atomically (``model.save_checkpoint`` writes a temp file
+    then renames), so a crash mid-save never corrupts the previous epoch's
+    checkpoint.  For step-granular async checkpointing with auto-resume,
+    use :class:`incubator_mxnet_tpu.resilience.CheckpointManager` instead.
+    """
     period = int(max(1, period))
 
     def _callback(iter_no, sym, arg, aux):
@@ -73,18 +79,23 @@ def do_checkpoint(prefix: str, period: int = 1):
 
         if (iter_no + 1) % period == 0:
             save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            telemetry.counter("ckpt_saves_total",
+                              {"mode": "epoch"}).inc()
 
     return _callback
 
 
 def module_checkpoint(mod, prefix: str, period: int = 1,
                       save_optimizer_states: bool = False):
-    """Module-level checkpoint callback (reference ``callback.py:27``)."""
+    """Module-level checkpoint callback (reference ``callback.py:27``).
+    Same atomic-write guarantee as :func:`do_checkpoint`."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
         if (iter_no + 1) % period == 0:
             mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+            telemetry.counter("ckpt_saves_total",
+                              {"mode": "epoch"}).inc()
 
     return _callback
 

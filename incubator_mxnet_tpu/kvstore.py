@@ -368,10 +368,11 @@ class DistKVStore(KVStore):
             return
         super().set_optimizer(optimizer)
 
-    def get_dead_nodes(self, timeout: float = 60):
+    def get_dead_nodes(self, timeout=None):
         """Nodes whose heartbeat is stale (``ps::Postoffice::GetDeadNodes``
         via kvstore_dist.h:177-190); empty on the collective transport,
-        where jax.distributed owns liveness."""
+        where jax.distributed owns liveness.  ``timeout`` defaults to the
+        ``TP_PS_DEADNODE_TIMEOUT`` env knob (60 s)."""
         if self._ps_client is not None:
             return self._ps_client.dead_nodes(timeout)
         return []

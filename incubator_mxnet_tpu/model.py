@@ -4,6 +4,7 @@ _update_params[_on_kvstore])."""
 from __future__ import annotations
 
 import logging
+import os
 from collections import namedtuple
 from typing import Dict, List, Optional, Tuple
 
@@ -21,17 +22,35 @@ BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
 
 
+def _atomic_write(path: str, writer) -> None:
+    """Write ``path`` via a same-directory temp file + ``os.replace`` so a
+    crash mid-write never leaves a truncated file under the final name.
+    Non-local URIs (``://``) bypass this — ``os.replace`` is local-only."""
+    if "://" in path:
+        writer(path)
+        return
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    try:
+        writer(tmp)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
 def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict,
                     aux_params: Dict) -> None:
     """Two-file checkpoint: ``prefix-symbol.json`` + ``prefix-%04d.params``
     (reference ``model.py:340``; NDArray container format analog of
-    ``src/ndarray/ndarray.cc:668``)."""
+    ``src/ndarray/ndarray.cc:668``).  Both files are written atomically
+    (temp file + rename) so a preempted save cannot corrupt an existing
+    checkpoint under the same name."""
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        _atomic_write("%s-symbol.json" % prefix, symbol.save)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd_save(param_name, save_dict)
+    _atomic_write(param_name, lambda p: nd_save(p, save_dict))
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 

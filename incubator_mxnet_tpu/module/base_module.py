@@ -129,8 +129,16 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """The training loop (reference ``base_module.py:376``)."""
+            monitor=None, checkpoint_manager=None):
+        """The training loop (reference ``base_module.py:376``).
+
+        ``checkpoint_manager`` (or the ``TP_CKPT_DIR`` env family via
+        ``resilience.CheckpointManager.from_env``) arms fault tolerance:
+        the loop auto-resumes from the newest committed checkpoint
+        (params, optimizer state, and the epoch/batch data cursor),
+        saves every ``every_n_steps`` batches, and honors SIGTERM/SIGINT
+        with a final synchronous save at the next step boundary (see
+        docs/fault_tolerance.md for the resume contract)."""
         assert num_epoch is not None, "please specify num_epoch"
 
         self.bind(data_shapes=train_data.provide_data,
@@ -148,6 +156,28 @@ class BaseModule:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+
+        # ---- fault tolerance (docs/fault_tolerance.md) ------------------
+        from .. import resilience
+        from ..resilience import faults as _faults
+
+        _cm = checkpoint_manager
+        if _cm is None:
+            _cm = resilience.CheckpointManager.from_env()
+        _global_step = 0
+        _resume_nbatch = 0
+        if _cm is not None:
+            resilience.install_preemption_handler()
+            _meta = _cm.restore_latest(self)
+            if _meta is not None:
+                _extra = _meta.get("extra", {})
+                begin_epoch = int(_extra.get("epoch", begin_epoch))
+                _resume_nbatch = int(_extra.get("nbatch", 0))
+                _global_step = int(_meta.get("step", 0))
+                self.logger.info(
+                    "Auto-resumed from checkpoint: epoch %d, batch %d "
+                    "(global step %d)", begin_epoch, _resume_nbatch,
+                    _global_step)
 
         # ---- overlap window (docs/input_pipeline.md) --------------------
         # TP_MAX_INFLIGHT>0 bounds dispatch via a ring of per-step fence
@@ -199,6 +229,17 @@ class BaseModule:
                 next_data_batch = next(data_iter)
             while not end_of_batch:
                 data_batch = next_data_batch
+                if _resume_nbatch > 0:
+                    # auto-resume replay: advance the data cursor to the
+                    # checkpointed batch without computing, so the resumed
+                    # stream matches the uninterrupted run batch for batch
+                    _resume_nbatch -= 1
+                    nbatch += 1
+                    try:
+                        next_data_batch = next(data_iter)
+                    except StopIteration:
+                        end_of_batch = True
+                    continue
                 if monitor is not None:
                     monitor.tic()
                 if _tele:
@@ -266,6 +307,27 @@ class BaseModule:
                                            locals=locals())
                     for cb in _as_list(batch_end_callback):
                         cb(params)
+                # ---- step boundary: fault hook + checkpoint cadence ----
+                _global_step += 1
+                _faults.inject("step", step=_global_step)
+                if _cm is not None:
+                    _due = resilience.preemption_requested() or (
+                        _cm.every_n_steps > 0
+                        and _global_step % _cm.every_n_steps == 0)
+                    if _due and _ring is not None:
+                        # fence in-flight steps before the host snapshot
+                        _ring.drain()
+                    if _cm.step_end(self, _global_step,
+                                    extra={"epoch": epoch,
+                                           "nbatch": nbatch}):
+                        if _dev_metric is not None:
+                            _dev_metric.drain()
+                        if _ring is not None:
+                            _ring.drain()
+                        self.logger.info(
+                            "Preemption checkpoint committed at step %d "
+                            "— exiting fit cleanly", _global_step)
+                        return
 
             if _dev_metric is not None:
                 _dev_metric.drain()  # fold the tail window before logging

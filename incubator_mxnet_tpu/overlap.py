@@ -28,7 +28,7 @@ import numpy as np
 from . import telemetry
 from .base import get_env
 
-__all__ = ["max_inflight", "fence_handle", "InflightRing"]
+__all__ = ["max_inflight", "fence_handle", "InflightRing", "drain_target"]
 
 _SLICE_FN = None
 
@@ -99,3 +99,23 @@ class InflightRing:
         while self._pending:
             self._wait(self._pending.popleft())
         telemetry.gauge("inflight_depth", {"scope": self.scope}).set(0)
+
+
+def drain_target(target) -> bool:
+    """Fence a train step's in-flight work before a host snapshot.
+
+    Checkpointing donated-buffer steps while TP_MAX_INFLIGHT>1 keeps
+    earlier steps dispatched-but-unexecuted; a snapshot taken then could
+    read buffers a queued step is about to recycle.  Prefer the target's
+    own ``sync()`` (ring drain + true host-read fence); fall back to a
+    bare ring ``drain()``.  Returns True when something was fenced.
+    """
+    sync = getattr(target, "sync", None)
+    if callable(sync):
+        sync()
+        return True
+    ring = getattr(target, "_ring", None)
+    if isinstance(ring, InflightRing):
+        ring.drain()
+        return True
+    return False

@@ -19,16 +19,25 @@ optimizer state restores cleanly onto a ``shard_optimizer=True`` step
 (each device reads just its ZeRO shard) and vice versa, so flipping
 ZeRO-1 on or off mid-training-run is a resume, not a migration
 (asserted by ``tests/test_zero.py``).
+
+The pieces are exposed separately (``state_dict`` / ``save_state`` /
+``restore_state`` / ``load_state_dict``) so ``resilience.
+CheckpointManager`` can snapshot the state on the train thread and
+hand the host copy to its background writer, while ``save_sharded`` /
+``restore_sharded`` stay the one-call synchronous path.
 """
 from __future__ import annotations
 
 import os
 from typing import Any, Dict
 
-__all__ = ["save_sharded", "restore_sharded"]
+__all__ = ["state_dict", "load_state_dict", "save_state", "restore_state",
+           "save_sharded", "restore_sharded"]
 
 
-def _state_dict(step) -> Dict[str, Any]:
+def state_dict(step) -> Dict[str, Any]:
+    """The resumable state of a train step, as a pytree of live (device)
+    arrays plus python scalars."""
     if hasattr(step, "flat_params"):
         # SymbolPipelineTrainStep: stage-stacked flat buffers
         return {
@@ -49,31 +58,8 @@ def _state_dict(step) -> Dict[str, Any]:
     }
 
 
-def save_sharded(path: str, step) -> None:
-    """Write a sharded checkpoint of a ``FusedTrainStep`` to ``path``
-    (a directory; created/overwritten)."""
-    import orbax.checkpoint as ocp
-
-    path = os.path.abspath(path)
-    with ocp.StandardCheckpointer() as ckpt:
-        ckpt.save(path, _state_dict(step), force=True)
-
-
-def restore_sharded(path: str, step) -> None:
-    """Restore a checkpoint IN PLACE onto ``step``, preserving its
-    per-parameter shardings (tp-partitioned params restore partitioned)."""
-    import jax
-    import orbax.checkpoint as ocp
-
-    path = os.path.abspath(path)
-    # restore against abstract targets carrying the step's shardings so
-    # every shard lands directly on its owning device
-    template = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-        if isinstance(x, jax.Array) else x,
-        _state_dict(step))
-    with ocp.StandardCheckpointer() as ckpt:
-        state = ckpt.restore(path, template)
+def load_state_dict(step, state: Dict[str, Any]) -> None:
+    """Assign a restored state dict back onto ``step`` in place."""
     if hasattr(step, "flat_params"):
         step.flat_params = state["flat_params"]
         step.opt_states = tuple(state["opt_states"])
@@ -87,3 +73,41 @@ def restore_sharded(path: str, step) -> None:
     step.aux = dict(state["aux"])
     step.num_update = int(state["num_update"])
     step._key = state["rng_key"]
+
+
+def save_state(path: str, state: Dict[str, Any]) -> None:
+    """Write a state pytree (device arrays or host snapshots) to ``path``
+    — a directory; created/overwritten."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckpt:
+        ckpt.save(path, state, force=True)
+
+
+def restore_state(path: str, step) -> Dict[str, Any]:
+    """Read a checkpoint back, resharded onto the LIVE layout of ``step``:
+    the restore template carries the step's current shardings, so every
+    shard lands directly on its owning device."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    template = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if isinstance(x, jax.Array) else x,
+        state_dict(step))
+    with ocp.StandardCheckpointer() as ckpt:
+        return ckpt.restore(path, template)
+
+
+def save_sharded(path: str, step) -> None:
+    """Write a sharded checkpoint of a ``FusedTrainStep`` to ``path``
+    (a directory; created/overwritten)."""
+    save_state(path, state_dict(step))
+
+
+def restore_sharded(path: str, step) -> None:
+    """Restore a checkpoint IN PLACE onto ``step``, preserving its
+    per-parameter shardings (tp-partitioned params restore partitioned)."""
+    load_state_dict(step, restore_state(path, step))

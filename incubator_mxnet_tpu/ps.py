@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import random
 import socket
 import socketserver
 import struct
@@ -35,6 +36,7 @@ import numpy as np
 
 from . import telemetry
 from .base import MXNetError, get_env
+from .resilience import faults as _faults
 
 __all__ = ["Scheduler", "PSServer", "PSClient", "node_env", "DEFAULT_PORT"]
 
@@ -51,6 +53,43 @@ def _verb_labels(verb: str) -> Dict[str, str]:
 
 DEFAULT_PORT = 9091
 _HDR = struct.Struct("!I")
+
+
+# --------------------------------------------------------------------------
+# timeouts + retry policy (docs/fault_tolerance.md knob table)
+# --------------------------------------------------------------------------
+# Every hang-prone wait is env-configurable so an orchestrator can trade
+# patience for fast failure; the defaults match the old hardcoded values.
+
+
+def _rendezvous_timeout() -> float:
+    return float(get_env("PS_RENDEZVOUS_TIMEOUT", 120.0, float))
+
+
+def _barrier_timeout() -> float:
+    return float(get_env("PS_BARRIER_TIMEOUT", 300.0, float))
+
+
+def _sync_pull_timeout() -> float:
+    return float(get_env("PS_SYNC_PULL_TIMEOUT", 300.0, float))
+
+
+def _deadnode_timeout() -> float:
+    return float(get_env("PS_DEADNODE_TIMEOUT", 60.0, float))
+
+
+def _heartbeat_interval() -> float:
+    return float(get_env("PS_HEARTBEAT_INTERVAL", 5.0, float))
+
+
+def _retry_backoff(attempt: int) -> float:
+    """Exponential backoff with decorrelating jitter for connect/RPC
+    retries (replaces the old fixed 0.2 s sleep, which synchronizes
+    every retrying peer into thundering-herd waves)."""
+    base = float(get_env("PS_RETRY_BASE", 0.05, float))
+    cap = float(get_env("PS_RETRY_MAX", 2.0, float))
+    delay = min(cap, base * (2.0 ** attempt))
+    return delay * (0.5 + 0.5 * random.random())
 
 # Bound by ``kvstore_server`` BEFORE the serve loop parks the main thread.
 # Handler threads must NOT run import statements: the server blocks inside
@@ -124,15 +163,19 @@ def _recv_msg(sock: socket.socket) -> Any:
 def _connect(addr: Tuple[str, int], timeout: float = 60.0,
              connect_retry: float = 0.0) -> socket.socket:
     """Connect with optional retry window — peers race the scheduler's
-    startup (ps-lite's Van retries connects the same way)."""
+    startup (ps-lite's Van retries connects the same way), backing off
+    exponentially with jitter instead of hammering a fixed cadence."""
     deadline = time.time() + connect_retry
+    attempt = 0
     while True:
         try:
             return socket.create_connection(addr, timeout=timeout)
         except (ConnectionRefusedError, socket.timeout, OSError):
-            if time.time() >= deadline:
+            remaining = deadline - time.time()
+            if remaining <= 0:
                 raise
-            time.sleep(0.2)
+            time.sleep(min(_retry_backoff(attempt), remaining))
+            attempt += 1
 
 
 def _rpc(addr: Tuple[str, int], obj: Any, timeout: float = 60.0,
@@ -255,6 +298,9 @@ class _Node:
     def stop(self) -> None:
         self._stopped.set()
         self._srv.shutdown()
+        # a stopped node must refuse NEW connections (a dead host does);
+        # established handler threads drain until their peer closes
+        self._srv.server_close()
 
     def _handle(self, msg, handler):
         raise NotImplementedError
@@ -288,6 +334,18 @@ class Scheduler(_Node):
         self._config: Dict[str, Any] = {}
         self._done = 0
 
+    def _dead_now(self, now: float) -> List[str]:
+        """Nodes with stale heartbeats (caller holds ``self._lock``)."""
+        stale = _deadnode_timeout()
+        return sorted(n for n, t in self._last_seen.items()
+                      if now - t > stale)
+
+    @staticmethod
+    def _wait_slice(remaining: float) -> float:
+        # wake often enough to notice a death well inside the stale
+        # window, without spinning
+        return min(remaining, max(0.05, _deadnode_timeout() / 4.0))
+
     def _handle(self, msg, handler):
         cmd = msg["cmd"]
         now = time.time()
@@ -306,12 +364,26 @@ class Scheduler(_Node):
             # min_gen > 0 lets a worker wait for a REPLACEMENT server
             # after observing a death (the recovery path)
             min_gen = msg.get("min_gen", 0)
+            deadline = time.time() + _rendezvous_timeout()
             with self._lock:
                 while (len(self._servers) < self.num_servers
                        or self._server_gen < min_gen):
-                    if not self._lock.wait(timeout=120):
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
                         return {"status": "error",
-                                "error": "rendezvous timeout"}
+                                "error": "rendezvous timeout after %.0fs "
+                                         "(%d/%d servers registered)"
+                                         % (_rendezvous_timeout(),
+                                            len(self._servers),
+                                            self.num_servers)}
+                    self._lock.wait(timeout=self._wait_slice(remaining))
+                    dead = self._dead_now(time.time())
+                    if dead:
+                        # abandon instead of waiting out the full window:
+                        # a dead peer cannot register
+                        return {"status": "error", "dead": dead,
+                                "error": "rendezvous abandoned; "
+                                         "dead nodes: %s" % dead}
                 return {"status": "ok", "gen": self._server_gen,
                         "servers": [self._servers[i]
                                     for i in sorted(self._servers)]}
@@ -327,10 +399,26 @@ class Scheduler(_Node):
                     self._barrier_gen[bid] = gen + 1
                     self._lock.notify_all()
                 else:
+                    deadline = time.time() + _barrier_timeout()
                     while self._barrier_gen.get(bid, 0) == gen:
-                        if not self._lock.wait(timeout=300):
+                        remaining = deadline - time.time()
+                        if remaining <= 0:
                             return {"status": "error",
-                                    "error": "barrier timeout"}
+                                    "error": "barrier %r timeout after "
+                                             "%.0fs (%d/%d arrived)"
+                                             % (bid, _barrier_timeout(),
+                                                self._barriers.get(bid, 0),
+                                                self.num_workers)}
+                        self._lock.wait(
+                            timeout=self._wait_slice(remaining))
+                        dead = self._dead_now(time.time())
+                        if dead:
+                            # a dead peer can never arrive — fail the
+                            # barrier NOW and name the culprits
+                            return {"status": "error", "dead": dead,
+                                    "error": "barrier %r abandoned; "
+                                             "dead nodes: %s"
+                                             % (bid, dead)}
             return {"status": "ok"}
         if cmd == "dead_nodes":
             timeout = msg.get("timeout", 60)
@@ -433,7 +521,7 @@ class PSServer(_Node):
 
     def _heartbeat_loop(self):
         node = "server%d" % self.server_id
-        while not self._hb_stop.wait(5.0):
+        while not self._hb_stop.wait(_heartbeat_interval()):
             if self._stopped.is_set():
                 return
             try:
@@ -505,9 +593,11 @@ class PSServer(_Node):
                         return self._round.get(key, 0) >= want
 
                     while not _ready():
-                        if not self._lock.wait(timeout=300):
+                        if not self._lock.wait(
+                                timeout=_sync_pull_timeout()):
                             return {"status": "error",
-                                    "error": "sync pull timeout"}
+                                    "error": "sync pull timeout after "
+                                             "%.0fs" % _sync_pull_timeout()}
                 if key not in self._store:
                     return {"status": "error",
                             "error": "key %r not initialized" % (key,)}
@@ -561,8 +651,11 @@ class PSClient:
                                                    "0")))
         reply = _rpc(self.scheduler, {"cmd": "get_nodes",
                                       "node": self.node},
-                     timeout=180.0, connect_retry=60.0)
+                     timeout=_rendezvous_timeout() + 60.0,
+                     connect_retry=60.0)
         if reply["status"] != "ok":
+            # the scheduler names dead peers in the error when its
+            # liveness watch abandoned the rendezvous
             raise MXNetError("rendezvous failed: %s" % reply.get("error"))
         self.servers: List[Tuple[str, int]] = [tuple(a)
                                                for a in reply["servers"]]
@@ -578,7 +671,7 @@ class PSClient:
 
     # -------------------------------------------------------------- liveness
     def _heartbeat_loop(self):
-        while not self._hb_stop.wait(5.0):
+        while not self._hb_stop.wait(_heartbeat_interval()):
             try:
                 _rpc(self.scheduler, {"cmd": "heartbeat",
                                       "node": self.node})
@@ -586,7 +679,9 @@ class PSClient:
                 telemetry.counter("ps_heartbeat_miss_total",
                                   {"role": "worker"}).inc()
 
-    def dead_nodes(self, timeout: float = 60) -> List[str]:
+    def dead_nodes(self, timeout: Optional[float] = None) -> List[str]:
+        if timeout is None:
+            timeout = _deadnode_timeout()
         reply = _rpc(self.scheduler, {"cmd": "dead_nodes",
                                       "timeout": timeout,
                                       "node": self.node})
@@ -619,27 +714,37 @@ class PSClient:
 
     # --------------------------------------------------------- fault handling
     def _data_rpc(self, sidx: int, msg: Dict[str, Any]) -> Any:
-        """Data-plane RPC with dead-server handling.
+        """Data-plane RPC with transient-failure retry and dead-server
+        handling.
 
-        Default: a clean ``MXNetError`` naming the unreachable server and
-        the scheduler's dead-node list (the reference surfaces ps-lite van
+        Transient connection failures retry with exponential backoff +
+        jitter (``TP_PS_RPC_RETRIES`` rounds); exhausted retries raise a
+        clean ``MXNetError`` naming the unreachable server and the
+        scheduler's dead-node list (the reference surfaces ps-lite van
         errors the same way).  With ``recover_servers``: wait for a
-        replacement registration, re-seed it, retry once.
+        replacement registration, re-seed it, retry.  The
+        ``ps_drop@<verb>:<p>`` fault rule injects drops here, upstream of
+        the retry machinery, so tests drive this exact path.
         """
+        verb = msg.get("cmd", "?")
         last_exc: Optional[BaseException] = None
         tele = telemetry.enabled()
         if tele:
-            lab = _verb_labels(msg.get("cmd", "?"))
+            lab = _verb_labels(verb)
             telemetry.counter("ps_rpc_total", lab).inc()
             v = msg.get("value")
             if isinstance(v, np.ndarray):
                 telemetry.counter("ps_rpc_bytes_total", lab).inc(v.nbytes)
             t0 = time.monotonic()
-        # up to 3 recovery rounds: one generation bump can satisfy the
-        # wait while OUR server's replacement is still registering (a
-        # different server died too), so the retry may trip again
-        for attempt in range(3):
+        # with recovery: up to N recovery rounds — one generation bump can
+        # satisfy the wait while OUR server's replacement is still
+        # registering (a different server died too), so a retry may trip
+        # again.  Without recovery: plain backoff retries absorb transient
+        # drops instead of failing the job on the first broken socket.
+        attempts = max(1, int(get_env("PS_RPC_RETRIES", 3, int)))
+        for attempt in range(attempts):
             try:
+                _faults.inject(verb)
                 reply = self._pool.rpc(self.servers[sidx], msg)
                 if tele:
                     telemetry.histogram("ps_rpc_seconds", lab).observe(
@@ -653,9 +758,10 @@ class PSClient:
             except (ConnectionError, OSError) as exc:
                 last_exc = exc
                 telemetry.counter("ps_rpc_retries_total").inc()
-                if not self.recover_servers:
-                    break
-                self._recover(sidx)
+                if self.recover_servers:
+                    self._recover(sidx)
+                elif attempt + 1 < attempts:
+                    time.sleep(_retry_backoff(attempt))
         addr = self.servers[sidx]
         dead: List[str] = []
         try:
@@ -758,7 +864,8 @@ class PSClient:
             return
         reply = _rpc(self.scheduler, {"cmd": "barrier",
                                       "barrier_id": barrier_id,
-                                      "node": self.node}, timeout=600)
+                                      "node": self.node},
+                     timeout=_barrier_timeout() + 30.0)
         if reply["status"] != "ok":
             raise MXNetError("barrier failed: %s" % reply.get("error"))
 

@@ -1,0 +1,22 @@
+"""Fault tolerance: async checkpointing, preemption-aware resume, and
+deterministic fault injection.
+
+Three pillars (docs/fault_tolerance.md):
+
+- :class:`CheckpointManager` — periodic async checkpoints with atomic
+  commit markers, keep-last-N GC, and corrupt-checkpoint fallback;
+- preemption handling — SIGTERM/SIGINT request a final synchronous
+  checkpoint at the next step boundary, and ``Module.fit`` auto-resumes
+  from ``restore_latest()``;
+- :mod:`.faults` — the env-driven (``TP_FAULT_SPEC``) deterministic
+  fault injector tests use to *prove* crash-at-any-step recovery.
+"""
+from . import faults
+from .faults import InjectedFault
+from .manager import (CheckpointManager, clear_preemption,
+                      install_preemption_handler, preemption_requested,
+                      request_preemption)
+
+__all__ = ["CheckpointManager", "InjectedFault", "faults",
+           "install_preemption_handler", "preemption_requested",
+           "request_preemption", "clear_preemption"]

@@ -1,0 +1,188 @@
+"""Deterministic fault injection for resilience testing.
+
+The reference stack inherited ps-lite's chaos knobs (``PS_DROP_MSG``
+randomly drops Van messages so recovery paths get exercised); this is
+the TPU-port equivalent, widened to cover the whole training loop so
+``tests/test_resilience.py`` and the ``tools/check.py`` resilience gate
+can *prove* crash-at-any-step recovery instead of asserting it.
+
+Spec grammar (``TP_FAULT_SPEC``, comma-separated rules)::
+
+    action@point[=value][:prob]
+
+    crash@step=7        raise InjectedFault at step boundary 7
+    crash@save          raise inside the checkpoint writer, after the
+                        payload is on disk but BEFORE the commit marker
+                        (leaves a torn, uncommitted checkpoint dir)
+    ps_drop@push:0.2    drop 20% of ps push RPCs (ConnectionError,
+                        consumed by the client's retry/backoff path)
+
+Points: ``step`` (fit-loop step boundary), ``save`` (checkpoint
+writer), ``push``/``pull``/``init`` (ps data-plane RPCs).  Probabilistic
+rules draw from one ``random.Random(TP_FAULT_SEED)`` stream (default
+seed 0), so a given spec+seed fires on exactly the same RPCs every run
+— determinism is what lets an A/B test hold the fault schedule fixed.
+``crash`` rules fire AT MOST ONCE per injector: the process they model
+only dies once, and a resumed in-process loop that replays the crash
+step must not trip again.
+
+``TP_FAULT_EXIT=1`` upgrades ``crash`` from an exception to a hard
+``os._exit(43)`` — the subprocess-based kill tests use it to prove
+recovery against a genuinely dead process, not a caught exception.
+
+Every firing bumps ``faults_injected_total{action,point}`` and appends
+to the injector's host-side ``log`` (tests assert determinism on it).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+from typing import List, Optional, Tuple
+
+from .. import telemetry
+from ..base import MXNetError, get_env
+
+__all__ = ["InjectedFault", "configure", "reset", "inject", "active",
+           "injector"]
+
+
+class InjectedFault(MXNetError):
+    """Raised by a ``crash`` rule — stands in for the process dying."""
+
+
+class _Rule:
+    __slots__ = ("action", "point", "value", "prob", "fired")
+
+    def __init__(self, action: str, point: str, value: Optional[int],
+                 prob: float):
+        self.action = action
+        self.point = point
+        self.value = value
+        self.prob = prob
+        self.fired = False
+
+    def __repr__(self):
+        return "_Rule(%s@%s=%s:%s)" % (self.action, self.point,
+                                       self.value, self.prob)
+
+
+_ACTIONS = ("crash", "ps_drop")
+
+
+def _parse(spec: str) -> List[_Rule]:
+    rules = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "@" not in part:
+            raise MXNetError("bad fault rule %r: expected "
+                             "action@point[=value][:prob]" % part)
+        action, rest = part.split("@", 1)
+        action = action.strip()
+        if action not in _ACTIONS:
+            raise MXNetError("bad fault rule %r: unknown action %r "
+                             "(known: %s)" % (part, action,
+                                              ", ".join(_ACTIONS)))
+        prob = 1.0
+        if ":" in rest:
+            rest, p = rest.rsplit(":", 1)
+            try:
+                prob = float(p)
+            except ValueError:
+                raise MXNetError("bad fault rule %r: probability %r is "
+                                 "not a float" % (part, p)) from None
+        value: Optional[int] = None
+        if "=" in rest:
+            rest, v = rest.split("=", 1)
+            try:
+                value = int(v)
+            except ValueError:
+                raise MXNetError("bad fault rule %r: value %r is not an "
+                                 "int" % (part, v)) from None
+        rules.append(_Rule(action, rest.strip(), value, prob))
+    return rules
+
+
+class Injector:
+    """Parsed rule set + seeded RNG + host-side firing log."""
+
+    def __init__(self, rules: List[_Rule], seed: int):
+        self.rules = rules
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.log: List[Tuple[str, str, Optional[int]]] = []
+
+    def inject(self, point: str, step: Optional[int] = None) -> None:
+        for rule in self.rules:
+            if rule.point != point:
+                continue
+            if rule.value is not None and step != rule.value:
+                continue
+            if rule.prob < 1.0 and self.rng.random() >= rule.prob:
+                continue
+            if rule.action == "crash" and rule.fired:
+                continue
+            rule.fired = True
+            self.log.append((rule.action, point, step))
+            telemetry.counter("faults_injected_total",
+                              {"action": rule.action,
+                               "point": point}).inc()
+            if rule.action == "crash":
+                msg = ("injected crash at %s%s"
+                       % (point, "" if step is None else "=%d" % step))
+                if get_env("FAULT_EXIT", 0, int):
+                    logging.error("resilience: %s — hard exit", msg)
+                    os._exit(43)
+                raise InjectedFault(msg)
+            if rule.action == "ps_drop":
+                raise ConnectionError(
+                    "injected ps drop at %s (seed=%d)" % (point, self.seed))
+
+
+_LOCK = threading.Lock()
+_INJECTOR: Optional[Injector] = None
+
+
+def configure(spec: Optional[str] = None,
+              seed: Optional[int] = None) -> Injector:
+    """(Re)build the process-wide injector.  ``None`` arguments read the
+    ``TP_FAULT_SPEC`` / ``TP_FAULT_SEED`` env knobs."""
+    global _INJECTOR
+    with _LOCK:
+        if spec is None:
+            spec = get_env("FAULT_SPEC", "", str) or ""
+        if seed is None:
+            seed = int(get_env("FAULT_SEED", 0, int))
+        _INJECTOR = Injector(_parse(spec), seed)
+        return _INJECTOR
+
+
+def reset() -> None:
+    """Drop the injector; the next ``inject`` re-reads the env."""
+    global _INJECTOR
+    with _LOCK:
+        _INJECTOR = None
+
+
+def injector() -> Injector:
+    """The live injector (env-configured on first use)."""
+    inj = _INJECTOR
+    if inj is None:
+        inj = configure()
+    return inj
+
+
+def active() -> bool:
+    return bool(injector().rules)
+
+
+def inject(point: str, step: Optional[int] = None) -> None:
+    """Hook point — a no-op unless a configured rule matches ``point``."""
+    inj = _INJECTOR
+    if inj is None:
+        inj = configure()
+    if inj.rules:
+        inj.inject(point, step)

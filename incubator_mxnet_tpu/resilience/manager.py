@@ -1,0 +1,501 @@
+"""Async checkpoint manager + preemption-aware resume.
+
+The failure story ROADMAP item 1 asks for, built from the pieces the
+repo already has: ``parallel/checkpoint`` (orbax sharded save/restore
+with ZeRO reshard-on-restore), ``overlap.InflightRing`` (the only true
+execution fence on this platform), and ``telemetry``.
+
+Design (the Check-N-Run NSDI'22 shape — snapshot synchronously, persist
+asynchronously):
+
+1. **Snapshot on the train thread.**  The fused/pipeline steps DONATE
+   their state buffers to the next step call, so a background thread
+   holding live ``jax.Array`` refs would read recycled memory.  The
+   manager first fences in-flight work (``overlap.drain_target`` —
+   ``step.sync()`` / ring drain), then ``jax.device_get``s the state
+   dict.  That host copy is immutable; only it crosses the thread
+   boundary.
+2. **Write + commit marker in the background.**  The writer thread
+   persists the snapshot into ``<dir>/step_XXXXXXXX/`` and then — and
+   only then — creates the ``COMMIT`` marker (JSON metadata: step,
+   target kind, caller extras such as the data-iter cursor) via
+   fsync + atomic rename.  A crash mid-write leaves a directory without
+   a marker, which restore skips; readers never see a torn checkpoint.
+3. **Keep-last-N GC** runs after each commit, deleting older committed
+   steps beyond ``keep_last`` and failed (uncommitted) attempts older
+   than the newest commit.
+4. **Restore falls back**: ``restore_latest`` walks committed steps
+   newest-first and drops to the previous one when a directory turns
+   out corrupt.  Step targets restore through the resharding orbax
+   path, so a checkpoint written with ZeRO off resumes onto a ZeRO-on
+   step (and vice versa).
+5. **Fail fast**: a writer-thread exception is captured and re-raised
+   on the next ``step_end``/``save``/``wait`` — a run must not train
+   for hours believing it is protected while saves silently fail.
+
+Preemption: ``install_preemption_handler`` arms SIGTERM/SIGINT; the
+first signal requests a final synchronous checkpoint at the next step
+boundary (``step_end`` returns True → the loop exits cleanly), a second
+signal falls through to the previous handler.
+
+Targets: fused/pipeline train steps (anything exposing
+``opt_states``/``num_update``, saved via orbax) and ``Module``
+(host params + updater state + optimizer update counters, saved as
+``module.npz`` + ``optimizer.bin``).
+
+Env knobs: ``TP_CKPT_DIR``/``TP_CKPT_EVERY``/``TP_CKPT_KEEP``/
+``TP_CKPT_ASYNC`` (see ``from_env``); docs/fault_tolerance.md has the
+full contract.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import queue
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from ..base import MXNetError, get_env
+from ..overlap import drain_target
+from . import faults
+
+__all__ = ["CheckpointManager", "install_preemption_handler",
+           "preemption_requested", "request_preemption",
+           "clear_preemption"]
+
+_STEP_FMT = "step_%08d"
+_COMMIT = "COMMIT"
+
+
+# ---------------------------------------------------------------------------
+# preemption
+# ---------------------------------------------------------------------------
+
+_PREEMPT = threading.Event()
+_PREV_HANDLERS: Dict[int, Any] = {}
+
+
+def preemption_requested() -> bool:
+    """True once a SIGTERM/SIGINT (or ``request_preemption``) arrived."""
+    return _PREEMPT.is_set()
+
+
+def request_preemption() -> None:
+    """Programmatic preemption (what the signal handler calls)."""
+    _PREEMPT.set()
+    telemetry.counter("preemptions_total").inc()
+
+
+def clear_preemption() -> None:
+    _PREEMPT.clear()
+
+
+def _on_signal(signum, frame):
+    import signal as _signal
+
+    # one-shot: restore the previous handler so a SECOND signal acts
+    # normally (default SIGINT: KeyboardInterrupt; SIGTERM: kill) — an
+    # operator who really wants the process gone is not locked out
+    prev = _PREV_HANDLERS.pop(signum, None)
+    if prev is not None:
+        try:
+            _signal.signal(signum, prev)
+        except (ValueError, OSError):
+            pass
+    logging.warning("resilience: signal %d received — final checkpoint "
+                    "at the next step boundary, then clean exit", signum)
+    request_preemption()
+
+
+def install_preemption_handler(signals: Optional[Tuple[int, ...]] = None
+                               ) -> bool:
+    """Arm the preemption flag on SIGTERM/SIGINT.  Idempotent; signal
+    handlers can only be installed from the main thread — returns False
+    (and stays un-armed) anywhere else."""
+    import signal as _signal
+
+    if signals is None:
+        signals = (_signal.SIGTERM, _signal.SIGINT)
+    try:
+        for s in signals:
+            if s in _PREV_HANDLERS:
+                continue
+            _PREV_HANDLERS[s] = _signal.signal(s, _on_signal)
+    except ValueError:
+        # not the main thread
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# target state snapshot/restore (train steps + Module)
+# ---------------------------------------------------------------------------
+
+
+def _is_step_target(target) -> bool:
+    return hasattr(target, "opt_states") and hasattr(target, "num_update")
+
+
+def _module_state(target) -> Dict[str, Any]:
+    arg_p, aux_p = target.get_params()  # syncs host copies from devices
+    arrays = {("arg:%s" % k): np.asarray(v.asnumpy())
+              for k, v in arg_p.items()}
+    arrays.update({("aux:%s" % k): np.asarray(v.asnumpy())
+                   for k, v in aux_p.items()})
+    opt = None
+    updater = getattr(target, "_updater", None)
+    optimizer = getattr(target, "_optimizer", None)
+    if updater is not None:
+        # Updater.states alone is not enough for bit-exact resume: Adam's
+        # bias correction reads the per-index update counters off the
+        # Optimizer instance, so they ride along
+        opt = {
+            "updater": updater.get_states(),
+            "num_update": int(getattr(optimizer, "num_update", 0)),
+            "index_update_count": dict(
+                getattr(optimizer, "_index_update_count", {})),
+        }
+    return {"arrays": arrays, "optimizer": opt}
+
+
+def _module_restore(target, path: str) -> None:
+    data = np.load(os.path.join(path, "module.npz"))
+    arg_params, aux_params = {}, {}
+    for key in data.files:
+        kind, name = key.split(":", 1)
+        (arg_params if kind == "arg" else aux_params)[name] = data[key]
+    target.set_params(arg_params, aux_params, force_init=True)
+    opt_file = os.path.join(path, "optimizer.bin")
+    if os.path.exists(opt_file):
+        with open(opt_file, "rb") as f:
+            opt = pickle.loads(f.read())
+        updater = getattr(target, "_updater", None)
+        if updater is None:
+            raise MXNetError("checkpoint carries optimizer state but the "
+                             "target Module has no local updater")
+        updater.set_states(opt["updater"])
+        optimizer = getattr(target, "_optimizer", None)
+        if optimizer is not None:
+            optimizer.num_update = int(opt["num_update"])
+            optimizer._index_update_count = dict(opt["index_update_count"])
+
+
+def _tree_bytes(state) -> int:
+    total = 0
+    stack = [state]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            stack.extend(node.values())
+        elif isinstance(node, (list, tuple)):
+            stack.extend(node)
+        elif isinstance(node, bytes):
+            total += len(node)
+        elif hasattr(node, "nbytes"):
+            total += int(node.nbytes)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# the manager
+# ---------------------------------------------------------------------------
+
+
+class CheckpointManager:
+    """Periodic (optionally async) checkpointing with commit markers,
+    keep-last-N GC, corrupt-checkpoint fallback, and preemption saves.
+
+    Parameters
+    ----------
+    directory : checkpoint root; one ``step_XXXXXXXX/`` child per save
+    every_n_steps : cadence for :meth:`maybe_save`/:meth:`step_end`
+        (0 disables periodic saves; explicit :meth:`save` still works)
+    keep_last : committed checkpoints retained by GC (0 = keep all)
+    async_save : hand the host snapshot to a background writer thread
+        (the train loop only pays fence + D2H); False writes in the
+        caller's thread with orbax streaming straight from device
+    """
+
+    def __init__(self, directory: str, every_n_steps: int = 100,
+                 keep_last: int = 3, async_save: bool = True):
+        self.directory = os.path.abspath(directory)
+        self.every_n_steps = int(every_n_steps)
+        self.keep_last = int(keep_last)
+        self.async_save = bool(async_save)
+        os.makedirs(self.directory, exist_ok=True)
+        # host-side mirrors (benches/tests read these without telemetry)
+        self.saves_completed = 0
+        self.gc_removed = 0
+        self.last_save_seconds = 0.0
+        self.last_restore_seconds = 0.0
+        self._writer_exc: Optional[BaseException] = None
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        if self.async_save:
+            self._queue = queue.Queue()
+            self._thread = threading.Thread(target=self._writer_loop,
+                                            name="ckpt-writer", daemon=True)
+            self._thread.start()
+
+    @classmethod
+    def from_env(cls) -> Optional["CheckpointManager"]:
+        """Build from ``TP_CKPT_DIR``/``TP_CKPT_EVERY``/``TP_CKPT_KEEP``/
+        ``TP_CKPT_ASYNC``; None when no directory is configured."""
+        directory = get_env("CKPT_DIR", "", str)
+        if not directory:
+            return None
+        return cls(directory,
+                   every_n_steps=int(get_env("CKPT_EVERY", 100, int)),
+                   keep_last=int(get_env("CKPT_KEEP", 3, int)),
+                   async_save=bool(int(get_env("CKPT_ASYNC", 1, int))))
+
+    # ------------------------------------------------------------- inventory
+    def _step_dirs(self) -> List[Tuple[int, str]]:
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if not name.startswith("step_"):
+                continue
+            try:
+                step = int(name.split("_", 1)[1])
+            except ValueError:
+                continue
+            out.append((step, os.path.join(self.directory, name)))
+        out.sort()
+        return out
+
+    def committed_steps(self) -> List[int]:
+        """Steps with a COMMIT marker, ascending."""
+        return [s for s, p in self._step_dirs()
+                if os.path.exists(os.path.join(p, _COMMIT))]
+
+    def latest_step(self) -> Optional[int]:
+        """Newest committed step, or None."""
+        steps = self.committed_steps()
+        return steps[-1] if steps else None
+
+    def step_path(self, step: int) -> str:
+        return os.path.join(self.directory, _STEP_FMT % step)
+
+    def metadata(self, step: int) -> Dict[str, Any]:
+        with open(os.path.join(self.step_path(step), _COMMIT)) as f:
+            return json.load(f)
+
+    # ----------------------------------------------------------------- save
+    def save(self, target, step: int, extra: Optional[Dict] = None,
+             sync: bool = False) -> None:
+        """Checkpoint ``target`` as ``step``.  Async managers enqueue the
+        host snapshot; ``sync=True`` additionally waits for the write to
+        commit (the preemption final save)."""
+        self._check_writer()
+        kind, state = self._snapshot(target)
+        meta = {"step": int(step), "kind": kind, "extra": dict(extra or {})}
+        if self._queue is None:
+            self._write(int(step), kind, state, meta)
+            return
+        self._queue.put((int(step), kind, state, meta))
+        telemetry.gauge("ckpt_async_queue_depth").set(self._queue.qsize())
+        if sync:
+            self._queue.join()
+            self._check_writer()
+
+    def maybe_save(self, target, step: int,
+                   extra: Optional[Dict] = None) -> bool:
+        """Periodic save when ``step`` hits the cadence."""
+        if self.every_n_steps <= 0 or step <= 0 \
+                or step % self.every_n_steps:
+            return False
+        self.save(target, step, extra=extra)
+        return True
+
+    def step_end(self, target, step: int,
+                 extra: Optional[Dict] = None) -> bool:
+        """The per-step hook for training loops: re-raises a failed async
+        writer, honors a pending preemption request with a final
+        synchronous save (returns True → stop training), otherwise runs
+        the periodic :meth:`maybe_save` (returns False)."""
+        self._check_writer()
+        if preemption_requested():
+            self.save(target, step, extra=extra, sync=True)
+            logging.warning("resilience: preemption checkpoint committed "
+                            "at step %d; stopping cleanly", step)
+            return True
+        self.maybe_save(target, step, extra=extra)
+        return False
+
+    def wait(self) -> None:
+        """Block until every queued save committed; re-raises a writer
+        failure."""
+        if self._queue is not None:
+            self._queue.join()
+        self._check_writer()
+
+    def close(self) -> None:
+        """Drain queued saves and stop the writer thread.  Cleanup-safe:
+        does NOT re-raise a captured writer failure (``wait``/
+        ``step_end`` do)."""
+        if self._queue is not None and self._thread is not None \
+                and self._thread.is_alive():
+            self._queue.join()
+            self._queue.put(None)
+            self._thread.join(timeout=60)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # --------------------------------------------------------------- restore
+    def restore_latest(self, target) -> Optional[Dict[str, Any]]:
+        """Restore the newest committed checkpoint onto ``target``,
+        falling back to older commits when a directory is corrupt.
+        Returns the commit metadata (``{"step", "kind", "extra"}``) or
+        None when nothing restorable exists."""
+        for step in reversed(self.committed_steps()):
+            path = self.step_path(step)
+            t0 = time.monotonic()
+            try:
+                meta = self.metadata(step)
+                self._restore_into(target, path, meta)
+            except Exception as exc:  # noqa: BLE001 — fall back, by design
+                logging.warning(
+                    "resilience: checkpoint step %d at %s unreadable (%r) "
+                    "— falling back to the previous commit", step, path,
+                    exc)
+                telemetry.counter("ckpt_restore_failures_total").inc()
+                continue
+            dt = time.monotonic() - t0
+            self.last_restore_seconds = dt
+            telemetry.counter("restores_total").inc()
+            telemetry.histogram("ckpt_restore_seconds").observe(dt)
+            logging.info("resilience: resumed from checkpoint step %d "
+                         "(%.3fs)", step, dt)
+            return meta
+        return None
+
+    # -------------------------------------------------------------- internals
+    def _check_writer(self) -> None:
+        exc = self._writer_exc
+        if exc is not None:
+            raise exc
+
+    def _snapshot(self, target) -> Tuple[str, Any]:
+        # fence first: with TP_MAX_INFLIGHT>1 earlier steps may still be
+        # dispatched-but-unexecuted against buffers a queued step donates
+        drain_target(target)
+        if _is_step_target(target):
+            from ..parallel import checkpoint as pckpt
+
+            state = pckpt.state_dict(target)
+            if self._queue is not None:
+                import jax
+
+                # host snapshot: the async writer must never hold live
+                # (donatable) device arrays across step boundaries
+                state = jax.device_get(state)
+            return "step", state
+        if hasattr(target, "get_params"):
+            return "module", _module_state(target)
+        raise MXNetError("CheckpointManager: unsupported target type %r "
+                         "(want a fused/pipeline train step or a Module)"
+                         % type(target).__name__)
+
+    def _restore_into(self, target, path: str, meta: Dict) -> None:
+        kind = meta.get("kind", "step")
+        if kind == "step":
+            if not _is_step_target(target):
+                raise MXNetError("checkpoint %s holds train-step state "
+                                 "but the target is %r"
+                                 % (path, type(target).__name__))
+            from ..parallel import checkpoint as pckpt
+
+            state = pckpt.restore_state(os.path.join(path, "state"), target)
+            pckpt.load_state_dict(target, state)
+            return
+        _module_restore(target, path)
+
+    def _writer_loop(self) -> None:
+        assert self._queue is not None
+        while True:
+            job = self._queue.get()
+            try:
+                if job is None:
+                    return
+                if self._writer_exc is None:
+                    self._write(*job)
+            except BaseException as exc:  # noqa: BLE001 — reported fail-fast
+                # captured, surfaced on the next step boundary; keep
+                # draining so queue.join() can never hang
+                self._writer_exc = exc
+                logging.error("resilience: async checkpoint writer failed "
+                              "(%r) — surfacing at the next step boundary",
+                              exc)
+            finally:
+                self._queue.task_done()
+                telemetry.gauge("ckpt_async_queue_depth").set(
+                    self._queue.qsize())
+
+    def _write(self, step: int, kind: str, state, meta: Dict) -> None:
+        t0 = time.monotonic()
+        final = self.step_path(step)
+        if os.path.exists(final):
+            # leftovers of a crashed attempt at this very step
+            shutil.rmtree(final)
+        os.makedirs(final, exist_ok=True)
+        if kind == "step":
+            from ..parallel import checkpoint as pckpt
+
+            pckpt.save_state(os.path.join(final, "state"), state)
+        else:
+            np.savez(os.path.join(final, "module.npz"), **state["arrays"])
+            if state["optimizer"] is not None:
+                with open(os.path.join(final, "optimizer.bin"), "wb") as f:
+                    f.write(pickle.dumps(state["optimizer"]))
+        # fault hook sits between payload and marker: an injected crash
+        # here leaves exactly the torn state a real mid-save death would
+        faults.inject("save", step=step)
+        tmp = os.path.join(final, _COMMIT + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(final, _COMMIT))
+        dt = time.monotonic() - t0
+        self.saves_completed += 1
+        self.last_save_seconds = dt
+        telemetry.counter("ckpt_saves_total",
+                          {"mode": "async" if self._queue is not None
+                           else "sync"}).inc()
+        telemetry.histogram("ckpt_save_seconds").observe(dt)
+        telemetry.counter("ckpt_bytes").inc(_tree_bytes(state))
+        self._gc()
+
+    def _gc(self) -> None:
+        steps = self.committed_steps()
+        victims = steps[:-self.keep_last] if self.keep_last > 0 else []
+        for step in victims:
+            shutil.rmtree(self.step_path(step), ignore_errors=True)
+            self.gc_removed += 1
+            telemetry.counter("ckpt_gc_total").inc()
+        if not steps:
+            return
+        newest = steps[-1]
+        for step, path in self._step_dirs():
+            # failed attempts: older than the newest commit, no marker
+            if step < newest and \
+                    not os.path.exists(os.path.join(path, _COMMIT)):
+                shutil.rmtree(path, ignore_errors=True)
+                self.gc_removed += 1
+                telemetry.counter("ckpt_gc_total").inc()

@@ -24,7 +24,8 @@ Telemetry (``TP_TELEMETRY=1``): ``serve_queue_depth``,
 ``serve_batch_size``, ``serve_padding_waste``,
 ``serve_request_seconds``, ``serve_requests_total``,
 ``serve_rejected_total``, ``serve_deadline_expired_total``,
-``serve_compiles_total{phase=...}``.  See docs/serving.md.
+``serve_compiles_total{phase=...}``, ``serve_batcher_deaths_total``.
+See docs/serving.md.
 """
 from __future__ import annotations
 
@@ -152,6 +153,7 @@ class InferenceEngine:
         self._queue: List[_Pending] = []
         self._cond = threading.Condition()
         self._closed = False
+        self._worker_exc: Optional[BaseException] = None
         self._thread = threading.Thread(
             target=self._batcher_loop, name=name + "-batcher", daemon=True)
         self._thread.start()
@@ -169,6 +171,13 @@ class InferenceEngine:
         deadline = (time.perf_counter() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
         with self._cond:
+            if self._worker_exc is not None:
+                # fail-fast: a dead batcher thread must not let callers
+                # enqueue futures that can never resolve
+                raise MXNetError(
+                    "engine %r batcher thread died: %r — engine is "
+                    "unusable, create a new one"
+                    % (self.name, self._worker_exc)) from self._worker_exc
             if self._closed:
                 raise MXNetError("engine %r is closed" % self.name)
             if len(self._queue) >= self.max_queue:
@@ -249,12 +258,35 @@ class InferenceEngine:
             self._cond.wait(timeout=flush_at - now)
 
     def _batcher_loop(self) -> None:
-        while True:
-            with self._cond:
-                group = self._take_group()
-            if group is None:
-                return
-            self._run_group(group)
+        group: Optional[List[_Pending]] = None
+        try:
+            while True:
+                with self._cond:
+                    group = self._take_group()
+                if group is None:
+                    return
+                self._run_group(group)
+                group = None
+        except BaseException as exc:  # noqa — recorded, re-raised in submit()
+            self._die(exc, group)
+
+    def _die(self, exc: BaseException,
+             group: Optional[List[_Pending]] = None) -> None:
+        """The batcher thread died outside the per-future ``batch_fn``
+        handler (e.g. stacking a malformed input).  Fail every pending and
+        in-flight future now — a silent dead worker would leave clients
+        blocked on futures that can never resolve — and remember the
+        exception so the next :meth:`submit` re-raises it."""
+        with self._cond:
+            self._worker_exc = exc
+            self._closed = True
+            pending, self._queue = self._queue, []
+            self._cond.notify_all()
+        telemetry.counter("serve_batcher_deaths_total").inc()
+        for p in (group or []) + pending:
+            if not p.future.done():
+                p.future.set_exception(MXNetError(
+                    "engine %r batcher died: %r" % (self.name, exc)))
 
     def _run_group(self, group: List[_Pending]) -> None:
         n = len(group)

@@ -28,8 +28,13 @@ cache), ``executor``/``module`` (step latency, samples/sec, epochs),
 ``optimizer_state_bytes_per_device`` gauges labeled by train-step
 scope — the ZeRO-1 footprint signal), ``quant`` + its call sites
 (``quant_weight_bytes`` per serving component, ``quant_scale`` per fp8
-site/role, ``quant_amax_rescales_total`` — docs/quantization.md), and
-device memory via ``jax.local_devices()[*].memory_stats()``.
+site/role, ``quant_amax_rescales_total`` — docs/quantization.md),
+``resilience`` (``ckpt_saves_total{mode}``, ``ckpt_save_seconds``,
+``ckpt_bytes``, ``ckpt_async_queue_depth``, ``restores_total``,
+``ckpt_restore_seconds``, ``ckpt_restore_failures_total``,
+``ckpt_gc_total``, ``preemptions_total``, ``faults_injected_total``
+— docs/fault_tolerance.md), and device memory via
+``jax.local_devices()[*].memory_stats()``.
 
 Env controls::
 

@@ -24,7 +24,11 @@ Checks, in order:
    (``tests/test_overlap.py``; ``TP_CHECK_OVERLAP=0`` skips);
 7. **quant** — the quantized-path subset: int8 serving parity, the
    fp8 shift-task A/B gate and the default-path bit-exactness
-   (``tests/test_quant.py``; ``TP_CHECK_QUANT=0`` skips).
+   (``tests/test_quant.py``; ``TP_CHECK_QUANT=0`` skips);
+8. **resilience** — the fault-tolerance subset: the crash-and-resume
+   A/B bit-equality, torn-save fallback, preemption final save and
+   injector determinism (``tests/test_resilience.py``;
+   ``TP_CHECK_FAULT=0`` skips).
 
 Exit code 0 = clean; 1 = findings (printed one per line).
 """
@@ -251,6 +255,42 @@ def check_quant(problems):
                         + "\n  ".join(tail))
 
 
+def check_resilience(problems):
+    """Fault-tolerance gate (docs/fault_tolerance.md): the crash-and-
+    resume A/B — kill a run at step k via the deterministic injector,
+    restore, and require bit-identical parameters vs the uninterrupted
+    run — plus the torn-save fallback (crash between payload and commit
+    marker), preemption final-save, and injector determinism (needs jax
+    — skip with ``TP_CHECK_FAULT=0``)."""
+    if os.environ.get("TP_CHECK_FAULT", "1") == "0":
+        return
+    import subprocess
+
+    tests = "tests/test_resilience.py"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q",
+             "-p", "no:cacheprovider", "-p", "no:randomly",
+             tests + "::test_fused_kill_at_step_k_resumes_bit_exact",
+             tests + "::test_pipeline_kill_at_step_k_resumes_bit_exact",
+             tests + "::test_kill_and_resume_across_zero_flip",
+             tests + "::test_mid_save_crash_falls_back_to_previous_commit",
+             tests + "::test_fit_crash_at_step_k_auto_resumes_bit_exact",
+             tests
+             + "::test_preemption_forces_final_sync_save_off_cadence",
+             tests + "::test_injector_is_deterministic"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        problems.append("resilience: gate run did not finish: %s" % e)
+        return
+    if proc.returncode != 0:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-12:]
+        problems.append("resilience: crash-and-resume gate failed:\n  "
+                        + "\n  ".join(tail))
+
+
 def main():
     problems = []
     check_compile(problems)
@@ -260,6 +300,7 @@ def main():
     check_serving(problems)
     check_overlap(problems)
     check_quant(problems)
+    check_resilience(problems)
     for p in problems:
         print(p)
     print("%d file(s) checked, %d problem(s)"
