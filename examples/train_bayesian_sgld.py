@@ -73,9 +73,15 @@ def main():
     mod.bind(data_shapes=[("data", (B, 2))],
              label_shapes=[("softmax_label", (B,))])
     mod.init_params(mx.initializer.Xavier())
+    # Welling & Teh: the SGLD drift is lr/2 * (∇log prior + N/B * minibatch
+    # log-lik gradient) + N(0, lr).  SoftmaxOutput's grad is the minibatch
+    # MEAN, so without the N/B rescale the likelihood term is B/N times too
+    # weak relative to the injected noise and the chain never concentrates.
     mod.init_optimizer(optimizer="sgld",
-                       optimizer_params={"learning_rate": args.lr,
-                                         "wd": args.wd})
+                       optimizer_params={
+                           "learning_rate": args.lr,
+                           "rescale_grad": args.num_train / args.batch_size,
+                           "wd": args.wd})
     from incubator_mxnet_tpu.io import DataBatch
 
     def predict_probs(x):

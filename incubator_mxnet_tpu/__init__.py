@@ -59,6 +59,15 @@ del _maybe_init_distributed
 
 from . import base
 from .base import MXNetError
+
+# TSan-lite (docs/static_analysis.md): TP_LOCK_CHECK=1 arms the runtime
+# lock-order checker BEFORE any module creates its locks, so every
+# threading primitive in the process is order-checked from birth.
+if base.get_env("LOCK_CHECK", False, bool):
+    from .analysis.lock_checker import install_runtime_checker
+
+    install_runtime_checker()
+    del install_runtime_checker
 from .context import Context, cpu, tpu, gpu, cpu_pinned, current_context, \
     num_tpus, num_gpus
 from . import engine

@@ -1,0 +1,57 @@
+"""Static-analysis suite — the pre-execution correctness passes the
+reference got from nnvm graph passes, rebuilt for the jit-compiled world.
+
+Three pass families (ISSUE 8):
+
+- :mod:`.graph_verifier` — node-by-node shape/dtype re-inference over a
+  built ``Symbol`` DAG plus mesh/partition-spec validation, *before*
+  lowering ever touches XLA (the GSPMD trace-time-check pattern).
+- :mod:`.tracing_lint` — Python-AST lint for host syncs and recompile
+  hazards inside jitted code paths.
+- :mod:`.lock_checker` — static lock-acquisition-order graph over the
+  threaded modules, plus an opt-in runtime mode (``TP_LOCK_CHECK=1``)
+  that wraps ``threading.Lock`` to assert one global order and flag
+  held-lock blocking calls.
+- :mod:`.env_drift` — every ``TP_*`` knob the code reads must appear in
+  ``docs/env_var.md`` and vice versa.
+
+All passes report :class:`~.findings.Finding` records with file:line or
+graph-node provenance, honoring ``# tp-lint: disable=<rule> -- why``
+suppressions (see ``docs/static_analysis.md``).  ``tools/lint.py`` is
+the CLI; ``tools/check.py`` runs it as a default-on gate.
+"""
+# Lazy (PEP 562): the runtime lock checker must be importable from the
+# package __init__ before the op registry exists, and the graph pass
+# pulls in jax — neither belongs on the default import path.
+_EXPORTS = {
+    "Finding": ("findings", "Finding"),
+    "filter_suppressed": ("findings", "filter_suppressed"),
+    "load_suppressions": ("findings", "load_suppressions"),
+    "verify_graph": ("graph_verifier", "verify_graph"),
+    "lint_tracing_file": ("tracing_lint", "lint_file"),
+    "lint_tree": ("tracing_lint", "lint_tree"),
+    "LockOrderGraph": ("lock_checker", "LockOrderGraph"),
+    "analyze_lock_files": ("lock_checker", "analyze_lock_files"),
+    "install_runtime_checker": ("lock_checker",
+                                "install_runtime_checker"),
+    "uninstall_runtime_checker": ("lock_checker",
+                                  "uninstall_runtime_checker"),
+    "runtime_checker_active": ("lock_checker",
+                               "runtime_checker_active"),
+    "check_env_drift": ("env_drift", "check_env_drift"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(name)
+    import importlib
+
+    mod = importlib.import_module("." + mod_name, __name__)
+    value = getattr(mod, attr)
+    globals()[name] = value
+    return value

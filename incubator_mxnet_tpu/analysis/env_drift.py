@@ -1,0 +1,144 @@
+"""Env-knob drift pass: code ⟷ ``docs/env_var.md`` agreement.
+
+Every ``TP_*`` variable the code reads (via :func:`base.get_env`, which
+maps ``get_env("X")`` to ``TP_X``/``MXNET_X``, or via direct
+``os.environ`` access) must appear in ``docs/env_var.md``; every
+*exact* knob the doc lists must actually be read somewhere.  Glob rows
+like ``TP_BENCH_*`` document a family and satisfy any matching read.
+
+Rules: ``env-undocumented`` (read but absent from the doc) and
+``env-unread`` (documented but never read — stale doc).
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from .findings import Finding
+
+__all__ = ["check_env_drift", "collect_env_reads",
+           "collect_documented"]
+
+_DOC_TOKEN = re.compile(r"\b(TP_[A-Z0-9_]+(?:_\*|\*)?)")
+_SKIP_DIRS = {"tests", ".git", "__pycache__", ".claude"}
+
+
+def collect_documented(doc_path: str) -> Tuple[Dict[str, int], Set[str]]:
+    """(exact knob name -> doc line, glob patterns) listed in the doc."""
+    with open(doc_path, "r") as f:
+        lines = f.read().splitlines()
+    exact: Dict[str, int] = {}
+    globs: Set[str] = set()
+    for lineno, line in enumerate(lines, start=1):
+        for tok in _DOC_TOKEN.findall(line):
+            if tok.endswith("*"):
+                globs.add(tok)
+            else:
+                exact.setdefault(tok, lineno)
+    return exact, globs
+
+
+def _py_files(root: str) -> List[str]:
+    out = []
+    for base, dirs, files in os.walk(root):
+        dirs[:] = [d for d in dirs if d not in _SKIP_DIRS]
+        for f in files:
+            if f.endswith(".py"):
+                out.append(os.path.join(base, f))
+    return sorted(out)
+
+
+def collect_env_reads(repo_root: str) -> Dict[str, Tuple[str, int]]:
+    """TP_* name -> (file, line) of one read site.
+
+    Scans the package, ``tools/``, ``examples/`` and top-level entry
+    scripts; ``tests/`` is excluded (tests *set* knobs, they don't
+    define them).
+    """
+    roots = [os.path.join(repo_root, "incubator_mxnet_tpu"),
+             os.path.join(repo_root, "tools"),
+             os.path.join(repo_root, "examples")]
+    files: List[str] = []
+    for r in roots:
+        if os.path.isdir(r):
+            files.extend(_py_files(r))
+    for f in os.listdir(repo_root):
+        if f.endswith(".py"):
+            files.append(os.path.join(repo_root, f))
+
+    reads: Dict[str, Tuple[str, int]] = {}
+    for path in files:
+        with open(path, "r") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _dotted(node.func)
+            arg = node.args[0] if node.args else None
+            name = arg.value if isinstance(arg, ast.Constant) \
+                and isinstance(arg.value, str) else None
+            if fn is not None and fn.endswith("get_env") \
+                    and name is not None:
+                reads.setdefault("TP_" + name, (rel, node.lineno))
+            elif fn in ("os.getenv", "os.environ.get",
+                        "environ.get") and name is not None \
+                    and name.startswith("TP_"):
+                reads.setdefault(name, (rel, node.lineno))
+        # os.environ["TP_X"], "TP_X" in os.environ, setdefault, etc. —
+        # any literal TP_ constant in a non-test source counts as a use
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and re.fullmatch(r"TP_[A-Z0-9_]+", node.value):
+                reads.setdefault(node.value, (rel, node.lineno))
+    return reads
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def check_env_drift(repo_root: str,
+                    doc_path: str = None) -> List[Finding]:
+    doc_path = doc_path or os.path.join(repo_root, "docs",
+                                        "env_var.md")
+    exact, globs = collect_documented(doc_path)
+    reads = collect_env_reads(repo_root)
+    doc_rel = os.path.relpath(doc_path, repo_root)
+    findings: List[Finding] = []
+
+    def documented(name: str) -> bool:
+        if name in exact:
+            return True
+        return any(fnmatch.fnmatch(name, g) for g in globs)
+
+    for name, (file, line) in sorted(reads.items()):
+        if not documented(name):
+            findings.append(Finding(
+                rule="env-undocumented",
+                message="'%s' is read here but not documented in %s"
+                        % (name, doc_rel),
+                file=file, line=line))
+    for name, doc_line in sorted(exact.items()):
+        if name not in reads:
+            findings.append(Finding(
+                rule="env-unread",
+                message="'%s' is documented in %s but nothing reads "
+                        "it — stale doc or dead knob" % (name, doc_rel),
+                file=doc_rel, line=doc_line, severity="warning"))
+    return findings

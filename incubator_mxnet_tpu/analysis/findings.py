@@ -1,0 +1,113 @@
+"""Finding records + ``# tp-lint`` suppression directives.
+
+A finding anchors to either a source location (``file``/``line``, the
+AST passes) or a graph node (``node``, the graph verifier — node names
+carry ``name.py`` scope provenance).  Suppression is per-line::
+
+    risky_call()  # tp-lint: disable=lock-held-blocking -- socket IO is
+                  # serialized per-connection by design (Van semantics)
+
+The ``-- justification`` tail is mandatory: a bare ``disable=`` is
+itself reported as ``lint-bad-suppression``.  A directive on a line of
+its own applies to the next source line.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["Finding", "load_suppressions", "filter_suppressed"]
+
+_DIRECTIVE = re.compile(
+    r"#\s*tp-lint:\s*disable=([A-Za-z0-9_,\- ]+?)\s*(?:--\s*(.*))?$")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One reported violation."""
+
+    rule: str
+    message: str
+    file: Optional[str] = None
+    line: Optional[int] = None
+    node: Optional[str] = None
+    severity: str = "error"  # "error" | "warning"
+
+    def location(self) -> str:
+        if self.file is not None:
+            loc = self.file
+            if self.line is not None:
+                loc += ":%d" % self.line
+            return loc
+        if self.node is not None:
+            return "node '%s'" % self.node
+        return "<global>"
+
+    def render(self) -> str:
+        return "%s: %s: [%s] %s" % (self.location(), self.severity,
+                                    self.rule, self.message)
+
+    def to_dict(self) -> Dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+
+def load_suppressions(path: str, source: Optional[str] = None,
+                      ) -> Tuple[Dict[int, Set[str]], List[Finding]]:
+    """Parse suppression directives out of one source file.
+
+    Returns ``(line -> {rules}, problems)`` where *problems* are
+    malformed directives (missing justification).  A directive whose
+    line holds nothing but the comment suppresses the following line
+    instead, so long rule names don't force 100-col lines.
+    """
+    if source is None:
+        with open(path, "r") as f:
+            source = f.read()
+    by_line: Dict[int, Set[str]] = {}
+    problems: List[Finding] = []
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = _DIRECTIVE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        justification = (m.group(2) or "").strip()
+        if not justification:
+            problems.append(Finding(
+                rule="lint-bad-suppression",
+                message="suppression of %s has no '-- justification' "
+                        "tail; say why it is safe" % sorted(rules),
+                file=path, line=lineno))
+            continue
+        target = lineno
+        if text.lstrip().startswith("#"):
+            target = lineno + 1
+        by_line.setdefault(target, set()).update(rules)
+        # a trailing directive also covers its own line when code
+        # precedes the comment (target == lineno handled above)
+        by_line.setdefault(lineno, set()).update(rules)
+    return by_line, problems
+
+
+def filter_suppressed(findings: List[Finding]) -> List[Finding]:
+    """Drop findings whose file:line carries a matching directive; keep
+    everything else (including graph-node findings, which have no file
+    and therefore cannot be suppressed in source)."""
+    cache: Dict[str, Dict[int, Set[str]]] = {}
+    kept: List[Finding] = []
+    for f in findings:
+        if f.file is None or f.line is None:
+            kept.append(f)
+            continue
+        if f.file not in cache:
+            try:
+                supp, _ = load_suppressions(f.file)
+            except OSError:
+                supp = {}
+            cache[f.file] = supp
+        rules = cache[f.file].get(f.line, ())
+        if f.rule in rules or "all" in rules:
+            continue
+        kept.append(f)
+    return kept

@@ -1,0 +1,318 @@
+"""Pre-lowering Symbol-graph verifier.
+
+Re-runs shape/dtype inference node-by-node over a built ``Symbol`` DAG
+and reports structural defects *before* ``lower_symbol`` ever builds a
+jax function — the role the reference's nnvm InferShape/InferType
+passes played (``graph_executor.cc:826``), plus GSPMD-style trace-time
+sharding validation when a mesh and partition specs are supplied.
+
+Rules
+-----
+- ``graph-dangling-input``   edge references an output slot the producer
+  does not have (or a node appears twice under one name)
+- ``graph-shape-error``      per-node shape inference failed
+- ``graph-dtype-mismatch``   two floating inputs of one node disagree
+  (f32 meets f16 without an explicit Cast → silent upcast per step)
+- ``graph-unused-output``    a multi-output node's slot is neither
+  consumed nor a head (warning)
+- ``graph-rank-losing-reshape``  Reshape collapses rank while moving the
+  leading (batch) dim — the classic dp-sharding breaker (warning)
+- ``graph-spec-unknown-axis`` / ``graph-spec-rank`` /
+  ``graph-spec-indivisible``  partition spec names a missing mesh axis,
+  exceeds the tensor rank, or shards a non-divisible dim
+- ``graph-spec-conflict``    elementwise op joins inputs with different
+  inferred specs (implicit resharding)
+- ``graph-implicit-allgather``  contraction (FullyConnected/dot) over a
+  sharded dim, or a Reshape merging a sharded axis — each forces an
+  all-gather at compile time (warning)
+
+Node provenance comes from node names, which carry ``name.py`` Prefix
+scopes (``stage1_fc1`` etc.).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..base import dtype_np
+from ..ops.registry import parse_tuple
+from .findings import Finding
+
+__all__ = ["verify_graph"]
+
+# ops whose inputs must agree on floating dtype (joins of parallel
+# branches — exactly where an accidental f16/f32 meet happens)
+_ELEMWISE = {"elemwise_add", "elemwise_sub", "elemwise_mul",
+             "elemwise_div", "add_n", "Concat", "concat",
+             "broadcast_add", "broadcast_sub", "broadcast_mul",
+             "broadcast_div", "_plus", "_minus", "_mul", "_div"}
+
+_RESHAPE_OPS = {"Reshape", "reshape"}
+_CONTRACTION_OPS = {"FullyConnected", "dot", "batch_dot"}
+
+
+def _node_dtype(node, var_dtypes, out_dtypes):
+    """Floating dtype flowing out of a node (None = unknown/int)."""
+    if node.is_variable:
+        return var_dtypes.get(node.name)
+    return out_dtypes.get(id(node))
+
+
+def verify_graph(symbol, shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
+                 dtypes: Optional[Dict[str, Any]] = None,
+                 mesh_axes: Optional[Dict[str, int]] = None,
+                 specs: Optional[Dict[str, Tuple]] = None) -> List[Finding]:
+    """Statically verify one Symbol graph.
+
+    ``shapes``/``dtypes`` seed leaf variables (same keys as
+    ``infer_shape``); ``mesh_axes`` maps mesh axis name → size and
+    ``specs`` maps variable name → PartitionSpec-like tuple of
+    axis-name-or-None per dim (``("dp", None)``).
+    """
+    shapes = dict(shapes or {})
+    findings: List[Finding] = []
+    nodes = symbol.topo_nodes()
+    heads = {(id(n), i) for n, i in symbol._outputs}
+
+    # ---------------------------------------------------- structure
+    seen_names: Dict[str, int] = {}
+    consumed: Dict[int, set] = {}
+    for node in nodes:
+        # duplicate VARIABLE names are parameter sharing (the executor
+        # feeds arrays by name) — only duplicate op names are suspicious
+        if not node.is_variable:
+            seen_names[node.name] = seen_names.get(node.name, 0) + 1
+        for inp, idx in node.inputs:
+            if idx >= inp._num_outputs or idx < 0:
+                findings.append(Finding(
+                    rule="graph-dangling-input",
+                    message="input of '%s' references output %d of '%s' "
+                            "which has only %d output(s)"
+                            % (node.name, idx, inp.name,
+                               inp._num_outputs),
+                    node=node.name))
+            consumed.setdefault(id(inp), set()).add(idx)
+    for name, count in seen_names.items():
+        if count > 1:
+            findings.append(Finding(
+                rule="graph-dangling-input",
+                message="node name '%s' appears %d times — param "
+                        "sharing by accident?" % (name, count),
+                node=name))
+
+    for node in nodes:
+        if node.is_variable or node._num_outputs <= 1:
+            continue
+        used = consumed.get(id(node), set())
+        for i in range(node._num_outputs):
+            if i not in used and (id(node), i) not in heads:
+                findings.append(Finding(
+                    rule="graph-unused-output",
+                    message="output %d of multi-output node '%s' (%s) "
+                            "is never consumed"
+                            % (i, node.name, node.op.name),
+                    node=node.name, severity="warning"))
+
+    # ----------------------------------------- shape + dtype + spec
+    var_shapes: Dict[str, Optional[Tuple[int, ...]]] = {}
+    node_shapes: Dict[Tuple[int, int], Optional[Tuple[int, ...]]] = {}
+    var_dtypes: Dict[str, Any] = {}
+    out_dtypes: Dict[int, Any] = {}
+    # spec per (node id, out idx); None entries mean replicated dims
+    entry_specs: Dict[Tuple[int, int], Optional[Tuple]] = {}
+    dtypes = dict(dtypes or {})
+    specs = dict(specs or {})
+    mesh_axes = dict(mesh_axes or {})
+    check_specs = bool(mesh_axes) or bool(specs)
+
+    for node in nodes:
+        if node.is_variable:
+            s = shapes.get(node.name)
+            if s is None:
+                sa = node.attrs.get("__shape__")
+                if sa is not None:
+                    s = parse_tuple(sa)
+            var_shapes[node.name] = tuple(s) if s is not None else None
+            node_shapes[(id(node), 0)] = var_shapes[node.name]
+            dt = dtypes.get(node.name, node.attrs.get("__dtype__"))
+            if dt is not None:
+                dt = np.dtype(dtype_np(dt))
+                var_dtypes[node.name] = dt if dt.kind == "f" else None
+            spec = specs.get(node.name)
+            if check_specs and spec is not None:
+                spec = tuple(spec)
+                shp = var_shapes[node.name]
+                for axis in spec:
+                    if axis is not None and axis not in mesh_axes:
+                        findings.append(Finding(
+                            rule="graph-spec-unknown-axis",
+                            message="spec %r of '%s' names mesh axis "
+                                    "'%s' not in mesh %s"
+                                    % (spec, node.name, axis,
+                                       sorted(mesh_axes)),
+                            node=node.name))
+                if shp is not None:
+                    if len(spec) > len(shp):
+                        findings.append(Finding(
+                            rule="graph-spec-rank",
+                            message="spec %r of '%s' has %d entries for "
+                                    "a rank-%d tensor"
+                                    % (spec, node.name, len(spec),
+                                       len(shp)),
+                            node=node.name))
+                    else:
+                        for d, axis in enumerate(spec):
+                            size = mesh_axes.get(axis)
+                            if axis is None or size is None:
+                                continue
+                            if shp[d] % size != 0:
+                                findings.append(Finding(
+                                    rule="graph-spec-indivisible",
+                                    message="dim %d of '%s' (%d) is not "
+                                            "divisible by mesh axis "
+                                            "'%s' (size %d)"
+                                            % (d, node.name, shp[d],
+                                               axis, size),
+                                    node=node.name))
+            entry_specs[(id(node), 0)] = spec
+            continue
+
+        in_shapes = []
+        for inp, idx in node.inputs:
+            if inp.is_variable:
+                in_shapes.append(var_shapes.get(inp.name))
+            else:
+                in_shapes.append(node_shapes.get((id(inp), idx)))
+        try:
+            out_shapes = symbol._infer_node(node, in_shapes)
+            backfill = list(getattr(symbol, "_last_in_shapes", in_shapes))
+        except Exception as e:  # op rules raise MXNetError or ValueError
+            findings.append(Finding(
+                rule="graph-shape-error",
+                message="shape inference failed at '%s' (%s): %s"
+                        % (node.name, node.op.name, e),
+                node=node.name))
+            out_shapes = [None] * node._num_outputs
+            backfill = in_shapes
+        for i, s in enumerate(out_shapes):
+            node_shapes[(id(node), i)] = s
+        for (inp, idx), s in zip(node.inputs, backfill):
+            if inp.is_variable and s is not None \
+                    and var_shapes.get(inp.name) is None:
+                var_shapes[inp.name] = tuple(s)
+                node_shapes[(id(inp), 0)] = tuple(s)
+
+        # dtype agreement among floating inputs of join ops
+        in_dtypes = [_node_dtype(inp, var_dtypes, out_dtypes)
+                     for inp, _ in node.inputs]
+        floats = {dt for dt in in_dtypes if dt is not None}
+        if node.op.name in _ELEMWISE and len(floats) > 1:
+            pairs = ", ".join(
+                "%s:%s" % (inp.name, dt)
+                for (inp, _), dt in zip(node.inputs, in_dtypes)
+                if dt is not None)
+            findings.append(Finding(
+                rule="graph-dtype-mismatch",
+                message="'%s' (%s) joins inputs of different floating "
+                        "dtypes (%s) — insert an explicit Cast"
+                        % (node.name, node.op.name, pairs),
+                node=node.name))
+        if node.op.name in ("Cast", "cast", "amp_cast"):
+            dt = node.attrs.get("dtype")
+            out_dt = np.dtype(dtype_np(dt)) if dt is not None else None
+            out_dtypes[id(node)] = \
+                out_dt if out_dt is not None and out_dt.kind == "f" \
+                else None
+        elif floats:
+            out_dtypes[id(node)] = max(floats,
+                                       key=lambda d: d.itemsize)
+
+        # rank-losing reshape that moves the batch dim — only a hazard
+        # when the graph is being checked against a sharding context
+        # ((B,T,C)->(B*T,C) is idiomatic for replicated seq models)
+        if check_specs and node.op.name in _RESHAPE_OPS and node.inputs:
+            ins = in_shapes[0]
+            outs = out_shapes[0] if out_shapes else None
+            if ins is not None and outs is not None \
+                    and len(outs) < len(ins) and outs[0] != ins[0]:
+                findings.append(Finding(
+                    rule="graph-rank-losing-reshape",
+                    message="'%s' reshapes %s -> %s, collapsing rank "
+                            "across the leading (batch) dim"
+                            % (node.name, tuple(ins), tuple(outs)),
+                    node=node.name, severity="warning"))
+
+        if check_specs:
+            _propagate_specs(node, in_shapes, out_shapes, entry_specs,
+                             findings)
+
+    return findings
+
+
+def _propagate_specs(node, in_shapes, out_shapes, entry_specs, findings):
+    """Conservative spec propagation + all-gather/conflict detection."""
+    in_specs = [entry_specs.get((id(inp), idx))
+                for inp, idx in node.inputs]
+    op = node.op.name
+
+    nontrivial = [s for s in in_specs
+                  if s is not None and any(a is not None for a in s)]
+    if op in _ELEMWISE and len({s for s in in_specs
+                                if s is not None}) > 1 and nontrivial:
+        findings.append(Finding(
+            rule="graph-spec-conflict",
+            message="'%s' (%s) joins inputs with different partition "
+                    "specs %s — implicit reshard at the join"
+                    % (node.name, op,
+                       [tuple(s) if s else None for s in in_specs]),
+            node=node.name))
+
+    if op in _CONTRACTION_OPS and in_specs and in_specs[0] is not None:
+        data_spec = in_specs[0]
+        data_shape = in_shapes[0]
+        if data_shape is not None and len(data_spec) == len(data_shape):
+            # FullyConnected/dot contract over the trailing data dim
+            if data_spec[-1] is not None:
+                findings.append(Finding(
+                    rule="graph-implicit-allgather",
+                    message="'%s' (%s) contracts over dim %d which is "
+                            "sharded on axis '%s' — forces an "
+                            "all-gather of the activations"
+                            % (node.name, op, len(data_spec) - 1,
+                               data_spec[-1]),
+                    node=node.name, severity="warning"))
+
+    if op in _RESHAPE_OPS and in_specs and in_specs[0] is not None:
+        ins, outs = in_shapes[0], out_shapes[0] if out_shapes else None
+        spec = in_specs[0]
+        if ins is not None and outs is not None \
+                and len(spec) == len(ins) and len(outs) != len(ins):
+            sharded = [d for d, a in enumerate(spec) if a is not None]
+            merged = [d for d in sharded
+                      if d >= len(outs) or outs[d] != ins[d]]
+            if merged:
+                findings.append(Finding(
+                    rule="graph-implicit-allgather",
+                    message="'%s' reshapes %s -> %s merging sharded "
+                            "dim(s) %s — forces an all-gather first"
+                            % (node.name, tuple(ins), tuple(outs),
+                               merged),
+                    node=node.name, severity="warning"))
+
+    # propagate: same-rank & same leading dim keeps the spec; leading
+    # dim preserved keeps only the leading entry; otherwise replicated
+    out = None
+    if in_specs and in_specs[0] is not None and in_shapes \
+            and in_shapes[0] is not None:
+        spec, ins = in_specs[0], in_shapes[0]
+        outs = out_shapes[0] if out_shapes else None
+        if outs is not None and len(spec) == len(ins):
+            if tuple(outs) == tuple(ins):
+                out = tuple(spec)
+            elif len(outs) == len(ins) and outs[0] == ins[0]:
+                out = (spec[0],) + (None,) * (len(outs) - 1)
+            elif outs and outs[0] == ins[0]:
+                out = (spec[0],) + (None,) * (len(outs) - 1)
+    for i in range(node._num_outputs):
+        entry_specs[(id(node), i)] = out

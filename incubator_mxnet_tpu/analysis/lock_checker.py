@@ -1,0 +1,579 @@
+"""Lock-discipline checker: static acquisition-order graph + runtime
+TSan-lite (``TP_LOCK_CHECK=1``).
+
+Static pass
+-----------
+Parses the threaded modules, identifies lock objects created as
+``self.X = threading.Lock()/RLock()/Condition()`` (lock identity =
+``Class.attr``), and builds a global acquisition-order graph from
+nested ``with`` blocks — following ``self.method()`` calls one level
+deep so an outer lock held across a helper that takes another lock
+still produces the edge.  Rules:
+
+- ``lock-order-cycle``     two code paths acquire the same pair of
+  locks in opposite orders (the AB/BA deadlock shape)
+- ``lock-held-blocking``   a potentially unbounded blocking call runs
+  while a lock is held: ``queue.get()``/``.join()`` without timeout,
+  ``Thread.join()``, ``Future.result()`` without timeout,
+  ``jax.device_get``/``.block_until_ready()``, ``time.sleep``, socket
+  ``connect``/``recv``.  ``Condition.wait`` on the *held* condition is
+  exempt (wait releases it).
+
+Runtime pass
+------------
+:func:`install_runtime_checker` monkeypatches ``threading.Lock`` /
+``RLock`` / ``Condition`` with creation-site-labeled proxies that
+maintain a per-thread held stack, record every (outer → inner)
+acquisition edge at site granularity, and raise ``MXNetError`` the
+moment an inversion appears — on the *second* order, not on the
+eventual deadlock.  It also wraps ``queue.Queue.get``/``join`` and
+``jax.device_get`` to raise when called without a timeout while a
+checked lock is held.  Production code never pays: the wrapping only
+happens when ``TP_LOCK_CHECK=1`` and only affects locks created after
+install.
+"""
+from __future__ import annotations
+
+import ast
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..base import MXNetError
+from .findings import Finding
+
+__all__ = ["LockOrderGraph", "analyze_lock_files",
+           "install_runtime_checker", "uninstall_runtime_checker",
+           "runtime_checker_active"]
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock",
+               "threading.Condition", "Lock", "RLock", "Condition"}
+
+# dotted/bare callables whose invocation can block on the network or
+# the device for an unbounded time
+_BLOCKING_SIMPLE = {"time.sleep", "jax.device_get", "_connect", "_rpc",
+                    "_recv_msg", "_send_msg"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if any(kw.arg == "timeout" and not (
+            isinstance(kw.value, ast.Constant)
+            and kw.value.value is None)
+           for kw in call.keywords):
+        return True
+    # queue.get(True, 5) positional timeout
+    return len(call.args) >= 2
+
+
+class LockOrderGraph:
+    """Global acquisition-order graph accumulated across files."""
+
+    def __init__(self):
+        # (outer, inner) -> (file, line) of first sighting
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add(self, outer: str, inner: str, file: str, line: int):
+        if outer == inner:
+            return
+        self.edges.setdefault((outer, inner), (file, line))
+
+    def cycles(self) -> List[Finding]:
+        findings = []
+        seen: Set[Tuple[str, str]] = set()
+        for (a, b), (fa, la) in sorted(self.edges.items()):
+            if (b, a) in self.edges and (b, a) not in seen:
+                fb, lb = self.edges[(b, a)]
+                seen.add((a, b))
+                findings.append(Finding(
+                    rule="lock-order-cycle",
+                    message="lock order inversion: '%s' -> '%s' at "
+                            "%s:%d but '%s' -> '%s' at %s:%d"
+                            % (a, b, fa, la, b, a, fb, lb),
+                    file=fa, line=la))
+        # longer cycles: DFS over the order graph
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, []).append(b)
+        color: Dict[str, int] = {}
+        stack: List[str] = []
+
+        def dfs(u):
+            color[u] = 1
+            stack.append(u)
+            for v in adj.get(u, ()):
+                if color.get(v, 0) == 1:
+                    cyc = stack[stack.index(v):] + [v]
+                    if len(cyc) > 3:  # 2-cycles reported above
+                        site = self.edges[(u, v)]
+                        findings.append(Finding(
+                            rule="lock-order-cycle",
+                            message="lock order cycle %s"
+                                    % " -> ".join(cyc),
+                            file=site[0], line=site[1]))
+                elif color.get(v, 0) == 0:
+                    dfs(v)
+            stack.pop()
+            color[u] = 2
+
+        for u in list(adj):
+            if color.get(u, 0) == 0:
+                dfs(u)
+        return findings
+
+
+class _ClassInfo:
+    def __init__(self, name):
+        self.name = name
+        self.locks: Dict[str, str] = {}      # attr -> kind
+        self.attr_types: Dict[str, str] = {}  # attr -> ClassName
+        self.methods: Dict[str, ast.FunctionDef] = {}
+
+
+def _scan_classes(tree: ast.Module) -> Dict[str, _ClassInfo]:
+    classes: Dict[str, _ClassInfo] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = _ClassInfo(node.name)
+        classes[node.name] = info
+        for item in ast.walk(node):
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods.setdefault(item.name, item)
+            if isinstance(item, ast.Assign) \
+                    and isinstance(item.value, ast.Call):
+                ctor = _dotted(item.value.func)
+                for tgt in item.targets:
+                    d = _dotted(tgt)
+                    if d is None or not d.startswith("self."):
+                        continue
+                    attr = d[len("self."):]
+                    if ctor in _LOCK_CTORS:
+                        info.locks[attr] = ctor.split(".")[-1]
+                    elif ctor is not None and "." not in ctor:
+                        info.attr_types[attr] = ctor
+    return classes
+
+
+class _MethodWalker:
+    """Walk one method body tracking held locks; emit edges/findings."""
+
+    def __init__(self, path: str, classes: Dict[str, _ClassInfo],
+                 cls: _ClassInfo, graph: LockOrderGraph,
+                 findings: List[Finding], depth: int = 0):
+        self.path = path
+        self.classes = classes
+        self.cls = cls
+        self.graph = graph
+        self.findings = findings
+        self.depth = depth
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        d = _dotted(expr)
+        if d is None or not d.startswith("self."):
+            return None
+        attr = d[len("self."):]
+        if attr in self.cls.locks:
+            return "%s.%s" % (self.cls.name, attr)
+        return None
+
+    def walk_body(self, body, held: Tuple[str, ...]):
+        for stmt in body:
+            self._walk_stmt(stmt, held)
+
+    def _walk_stmt(self, stmt, held: Tuple[str, ...]):
+        if isinstance(stmt, ast.With):
+            inner_held = held
+            for item in stmt.items:
+                lock = self._lock_id(item.context_expr)
+                if lock is not None:
+                    for outer in inner_held:
+                        self.graph.add(outer, lock, self.path,
+                                       stmt.lineno)
+                    inner_held = inner_held + (lock,)
+                else:
+                    self._scan_calls(item.context_expr, inner_held)
+            self.walk_body(stmt.body, inner_held)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_calls(stmt.test, held)
+            self.walk_body(stmt.body, held)
+            self.walk_body(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_calls(stmt.iter, held)
+            self.walk_body(stmt.body, held)
+            self.walk_body(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk_body(stmt.body, held)
+            for h in stmt.handlers:
+                self.walk_body(h.body, held)
+            self.walk_body(stmt.orelse, held)
+            self.walk_body(stmt.finalbody, held)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: body runs later, not under the current locks
+            self.walk_body(stmt.body, ())
+            return
+        self._scan_calls(stmt, held)
+
+    # ---------------------------------------------------------- calls
+    def _scan_calls(self, node: ast.AST, held: Tuple[str, ...]):
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            self._check_call(call, held)
+
+    def _check_call(self, call: ast.Call, held: Tuple[str, ...]):
+        d = _dotted(call.func)
+        if d is None:
+            return
+        # explicit acquire() outside `with` — record edges only
+        lock = self._lock_id(call.func.value) \
+            if isinstance(call.func, ast.Attribute) else None
+        if lock is not None and isinstance(call.func, ast.Attribute) \
+                and call.func.attr in ("acquire", "__enter__"):
+            for outer in held:
+                self.graph.add(outer, lock, self.path, call.lineno)
+            return
+        if not held:
+            # still recurse into same-class helpers to find nested locks
+            self._follow(call, held)
+            return
+        # blocking-call detection under a held lock
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            recv = _dotted(call.func.value) or ""
+            if attr == "wait":
+                # Condition.wait on the innermost held lock releases it
+                if lock is not None and lock in held:
+                    return
+                if not _has_timeout(call):
+                    self._blocking(call, held,
+                                   "%s.wait() without timeout" % recv)
+                return
+            if attr in ("get", "join") and not _has_timeout(call):
+                # str.join(...) takes an iterable arg; queue/thread
+                # join() and queue get() are nullary-or-flag calls
+                if attr == "join" and call.args:
+                    return
+                if attr == "get" and not self._queue_like(recv):
+                    return
+                if attr == "join" and not self._queue_like(recv) \
+                        and not self._thread_like(recv):
+                    return
+                self._blocking(call, held,
+                               "%s.%s() without timeout" % (recv, attr))
+                return
+            if attr == "result" and not _has_timeout(call):
+                self._blocking(call, held,
+                               "%s.result() without timeout" % recv)
+                return
+            if attr == "block_until_ready":
+                self._blocking(call, held, "%s.block_until_ready()"
+                               % recv)
+                return
+            if attr in ("connect", "recv", "accept", "_connect",
+                        "_recv_msg", "recv_into", "sendall"):
+                self._blocking(call, held, "socket %s.%s()"
+                               % (recv, attr))
+                return
+        if d in _BLOCKING_SIMPLE:
+            self._blocking(call, held, "%s()" % d)
+            return
+        self._follow(call, held)
+
+    def _queue_like(self, recv: str) -> bool:
+        r = recv.lower()
+        return any(h in r for h in ("queue", "_q", ".q")) or r == "q"
+
+    def _thread_like(self, recv: str) -> bool:
+        r = recv.lower()
+        return any(h in r for h in ("thread", "worker", "_t"))
+
+    def _blocking(self, call, held, what):
+        self.findings.append(Finding(
+            rule="lock-held-blocking",
+            message="%s while holding %s can stall every thread "
+                    "contending for the lock" % (what, list(held)),
+            file=self.path, line=call.lineno))
+
+    def _follow(self, call: ast.Call, held: Tuple[str, ...]):
+        """One-level resolution of self.method() / self.attr.method()."""
+        if self.depth >= 2 or not isinstance(call.func, ast.Attribute):
+            return
+        d = _dotted(call.func)
+        if d is None:
+            return
+        parts = d.split(".")
+        if parts[0] != "self":
+            return
+        if len(parts) == 2 and parts[1] in self.cls.methods:
+            target_cls, meth = self.cls, self.cls.methods[parts[1]]
+        elif len(parts) == 3:
+            tname = self.cls.attr_types.get(parts[1])
+            tcls = self.classes.get(tname) if tname else None
+            if tcls is None or parts[2] not in tcls.methods:
+                return
+            target_cls, meth = tcls, tcls.methods[parts[2]]
+        else:
+            return
+        sub = _MethodWalker(self.path, self.classes, target_cls,
+                            self.graph, self.findings,
+                            depth=self.depth + 1)
+        sub.walk_body(meth.body, held)
+
+
+def analyze_lock_files(paths: List[str],
+                       graph: Optional[LockOrderGraph] = None,
+                       ) -> Tuple[List[Finding], LockOrderGraph]:
+    """Run the static pass over ``paths``; returns (findings, graph)."""
+    graph = graph or LockOrderGraph()
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, "r") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="lock-parse-error", message=str(e), file=path,
+                line=getattr(e, "lineno", 1) or 1))
+            continue
+        classes = _scan_classes(tree)
+        for cls in classes.values():
+            for meth in cls.methods.values():
+                walker = _MethodWalker(path, classes, cls, graph,
+                                       findings)
+                walker.walk_body(meth.body, ())
+    findings.extend(graph.cycles())
+    # the one-level call-following visits shared helpers once per
+    # caller — collapse identical sightings
+    seen: Set[Tuple] = set()
+    unique: List[Finding] = []
+    for f in findings:
+        key = (f.rule, f.file, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique, graph
+
+
+# ===========================================================================
+# runtime mode (TP_LOCK_CHECK=1)
+# ===========================================================================
+
+_state = None
+
+
+class _RuntimeState:
+    def __init__(self):
+        # capture originals FIRST: checked locks wrap these, so the
+        # factories below never recurse through the patched names
+        self.originals: Dict[str, object] = {
+            "Lock": threading.Lock, "RLock": threading.RLock,
+            "Condition": threading.Condition}
+        self.tls = threading.local()
+        self.mutex = self.originals["Lock"]()  # guards .edges
+        # (outer site, inner site) -> "file:line of acquisition"
+        self.edges: Dict[Tuple[str, str], str] = {}
+
+    def held(self) -> List["_CheckedLock"]:
+        st = getattr(self.tls, "stack", None)
+        if st is None:
+            st = self.tls.stack = []
+        return st
+
+
+def _call_site(skip: int = 2) -> str:
+    import sys
+
+    f = sys._getframe(skip)
+    return "%s:%d" % (f.f_code.co_filename, f.f_lineno)
+
+
+class _CheckedLock:
+    """threading.Lock proxy asserting one global acquisition order."""
+
+    def __init__(self, state: "_RuntimeState", site: str,
+                 reentrant: bool = False):
+        self._state = state
+        self.site = site
+        self._reentrant = reentrant
+        mk = state.originals["RLock" if reentrant else "Lock"]
+        self._lock = mk()
+
+    # ---- order tracking -------------------------------------------
+    def _note_acquired(self):
+        state = self._state
+        held = state.held()
+        if self._reentrant and any(l is self for l in held):
+            held.append(self)  # re-entry: no new edge
+            return
+        me = self.site
+        with state.mutex:
+            for outer in held:
+                if outer is self:
+                    continue
+                a, b = outer.site, me
+                if a == b:
+                    continue
+                state.edges.setdefault((a, b), _call_site(3))
+                if (b, a) in state.edges:
+                    raise MXNetError(
+                        "lock order inversion: lock@%s then lock@%s "
+                        "here, but lock@%s then lock@%s at %s "
+                        "(TP_LOCK_CHECK)"
+                        % (a, b, b, a, state.edges[(b, a)]))
+        held.append(self)
+
+    def _note_released(self):
+        held = self._state.held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                return
+
+    # ---- Lock API --------------------------------------------------
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            try:
+                self._note_acquired()
+            except BaseException:
+                self._lock.release()
+                raise
+        return got
+
+    def release(self):
+        self._note_released()
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked() if hasattr(self._lock, "locked") \
+            else False
+
+    # Condition(_CheckedLock) support: python's Condition delegates to
+    # these when present
+    def _is_owned(self):
+        return any(l is self for l in self._state.held())
+
+    def _release_save(self):
+        self._note_released()
+        return self._lock.release()
+
+    def _acquire_restore(self, saved):
+        self._lock.acquire()
+        self._state.held().append(self)
+
+
+class _CheckedCondition(threading.Condition):
+    """Condition over a checked lock; wait() correctly pops/pushes the
+    held stack via the checked lock's _release_save/_acquire_restore."""
+
+    def __init__(self, state: "_RuntimeState", site: str, lock=None):
+        if lock is None:
+            lock = _CheckedLock(state, site)
+        super().__init__(lock)
+
+
+def install_runtime_checker():
+    """Patch threading lock constructors (idempotent).  Locks created
+    *after* install are checked; existing locks are untouched."""
+    global _state
+    if _state is not None:
+        return
+    state = _RuntimeState()
+
+    def make_lock():
+        return _CheckedLock(state, _call_site())
+
+    def make_rlock():
+        return _CheckedLock(state, _call_site(), reentrant=True)
+
+    def make_condition(lock=None):
+        if lock is not None and not isinstance(lock, _CheckedLock):
+            # foreign lock: fall back to a stock Condition
+            return state.originals["Condition"](lock)
+        return _CheckedCondition(state, _call_site(), lock)
+
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    threading.Condition = make_condition
+
+    # held-lock blocking detection: queue waits and device_get
+    import queue as _queue
+
+    def checked(name, orig, timeout_kw_ok=True):
+        def wrapper(*args, **kwargs):
+            blocking = True
+            if name == "Queue.get":
+                blocking = (args[1] if len(args) > 1
+                            else kwargs.get("block", True))
+            has_timeout = kwargs.get("timeout") is not None \
+                or (name == "Queue.get" and len(args) > 2
+                    and args[2] is not None)
+            if blocking and not has_timeout and state.held():
+                sites = [l.site for l in state.held()]
+                raise MXNetError(
+                    "%s without timeout while holding lock(s) %s "
+                    "(TP_LOCK_CHECK): a blocked %s stalls every "
+                    "contender" % (name, sites, name))
+            return orig(*args, **kwargs)
+        return wrapper
+
+    state.originals["Queue.get"] = _queue.Queue.get
+    state.originals["Queue.join"] = _queue.Queue.join
+    _queue.Queue.get = checked("Queue.get", _queue.Queue.get)
+    _queue.Queue.join = checked("Queue.join", _queue.Queue.join)
+    try:
+        import jax
+
+        state.originals["jax.device_get"] = jax.device_get
+        jax.device_get = checked("jax.device_get", jax.device_get)
+    except ImportError:  # pragma: no cover - jax is a hard dep here
+        pass
+
+    _state = state
+
+
+def uninstall_runtime_checker():
+    """Restore the stock constructors.  Checked locks already handed
+    out keep working (they wrap real locks)."""
+    global _state
+    if _state is None:
+        return
+    threading.Lock = _state.originals["Lock"]
+    threading.RLock = _state.originals["RLock"]
+    threading.Condition = _state.originals["Condition"]
+    import queue as _queue
+
+    _queue.Queue.get = _state.originals["Queue.get"]
+    _queue.Queue.join = _state.originals["Queue.join"]
+    if "jax.device_get" in _state.originals:
+        import jax
+
+        jax.device_get = _state.originals["jax.device_get"]
+    _state = None
+
+
+def runtime_checker_active() -> bool:
+    return _state is not None

@@ -1,0 +1,423 @@
+"""AST lint for host-sync and recompile hazards inside jitted code.
+
+The jit boundary is this repo's bulk-exec segment: anything that forces
+a host round-trip inside it (``.item()``, ``float()``, ``np.asarray``)
+either raises a ConcretizationError at trace time or, worse, silently
+syncs per step; env reads inside a traced function bake the value in at
+trace time and recompile when it changes; a Python ``if`` on a tracer
+recompiles per branch; reading a donated buffer after the jitted call
+returns garbage.
+
+Rules
+-----
+- ``trace-host-sync``      ``.item()`` / ``float()`` / ``int()`` /
+  ``bool()`` / ``np.asarray`` / ``np.array`` on a traced value
+- ``trace-env-read``       ``os.environ`` / ``os.getenv`` / ``get_env``
+  inside a traced function body
+- ``trace-python-branch``  ``if``/``while`` test on a bare tracer
+  (``x.shape``-family attribute reads, ``is None`` checks,
+  ``isinstance`` and ``len`` are trace-time-static and exempt)
+- ``trace-donated-reuse``  a bare-name argument passed at a donated
+  position of a ``donate_argnums`` jit is read again before being
+  reassigned
+
+Traced functions = defs decorated with ``jax.jit`` / ``partial(jax.jit,
+...)``, defs passed to a ``jax.jit(...)`` call anywhere in the module,
+and defs nested inside either.  ``static_argnums``/``static_argnames``
+parameters are concrete and removed from the taint set.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+__all__ = ["lint_file", "lint_tree"]
+
+# attribute reads that are static under tracing (abstract-value metadata)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "nbytes", "itemsize", "at"}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_NP_SYNCS = {"asarray", "array", "copy", "asnumpy"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this expression denote jax.jit (possibly via partial)?"""
+    d = _dotted(node)
+    if d in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fn = _dotted(node.func)
+        if fn in ("partial", "functools.partial") and node.args:
+            return _is_jit_expr(node.args[0])
+        # jax.jit(f, ...) used as a decorator factory
+        if fn in ("jax.jit", "jit"):
+            return True
+    return False
+
+
+def _jit_call_info(call: ast.Call):
+    """If ``call`` is ``jax.jit(...)`` return (fn_arg, static_names,
+    donate_positions); else None."""
+    if _dotted(call.func) not in ("jax.jit", "jit"):
+        return None
+    fn_arg = call.args[0] if call.args else None
+    static: Set[int] = set()
+    static_names: Set[str] = set()
+    donate: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            static |= set(_int_tuple(kw.value))
+        elif kw.arg == "static_argnames":
+            static_names |= set(_str_tuple(kw.value))
+        elif kw.arg == "donate_argnums":
+            donate |= set(_int_tuple(kw.value))
+    return fn_arg, static, static_names, donate
+
+
+def _int_tuple(node) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)]
+    return []
+
+
+def _str_tuple(node) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _TracedBodyLinter(ast.NodeVisitor):
+    """Lint one traced function body with a taint set of tracer names."""
+
+    def __init__(self, path: str, fn: ast.AST, tainted: Set[str],
+                 findings: List[Finding]):
+        self.path = path
+        self.fn = fn
+        self.tainted = set(tainted)
+        self.findings = findings
+
+    def _emit(self, rule, node, msg):
+        self.findings.append(Finding(
+            rule=rule, message=msg, file=self.path, line=node.lineno))
+
+    # -- taint propagation through simple assignments -------------------
+    def visit_Assign(self, node: ast.Assign):
+        self.generic_visit(node)
+        rhs_tainted = bool(self._tainted_names(node.value))
+        for tgt in node.targets:
+            for n in ast.walk(tgt):
+                if isinstance(n, ast.Name):
+                    if rhs_tainted:
+                        self.tainted.add(n.id)
+                    else:
+                        self.tainted.discard(n.id)
+
+    def _tainted_names(self, expr: ast.AST) -> Set[str]:
+        """Tainted bare names in ``expr``, ignoring static-attr reads."""
+        out: Set[str] = set()
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+                continue
+            if isinstance(n, ast.Name) and n.id in self.tainted:
+                out.add(n.id)
+        # drop names only reachable under static attrs / len / isinstance
+        covered: Set[str] = set()
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+                covered |= _names_in(n.value)
+            if isinstance(n, ast.Call):
+                fn = _dotted(n.func)
+                if fn in ("isinstance", "len", "getattr", "hasattr",
+                          "type"):
+                    for a in n.args:
+                        covered |= _names_in(a)
+            if isinstance(n, ast.Compare):
+                comps = [n.left] + list(n.comparators)
+                if any(isinstance(o, (ast.Is, ast.IsNot))
+                       for o in n.ops) and any(
+                        isinstance(c, ast.Constant) and c.value is None
+                        for c in comps):
+                    for c in comps:
+                        covered |= _names_in(c)
+        return out - covered
+
+    # -- host syncs ------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        fn = _dotted(node.func)
+        # tainted.item() / .tolist() / .asnumpy()
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("item", "tolist", "asnumpy",
+                                       "__float__"):
+            if self._tainted_names(node.func.value):
+                self._emit("trace-host-sync", node,
+                           "'.%s()' on traced value '%s' forces a host "
+                           "sync inside jit"
+                           % (node.func.attr,
+                              _dotted(node.func.value) or "<expr>"))
+        elif fn in _HOST_CASTS and node.args \
+                and self._tainted_names(node.args[0]):
+            self._emit("trace-host-sync", node,
+                       "'%s()' on a traced value concretizes inside "
+                       "jit" % fn)
+        elif fn in {"np.%s" % s for s in _NP_SYNCS} \
+                | {"numpy.%s" % s for s in _NP_SYNCS} \
+                | {"onp.%s" % s for s in _NP_SYNCS}:
+            if node.args and self._tainted_names(node.args[0]):
+                self._emit("trace-host-sync", node,
+                           "'%s' on a traced value pulls it to host "
+                           "inside jit" % fn)
+        # env reads anywhere in a traced body
+        if fn in ("os.getenv", "get_env", "base.get_env",
+                  "os.environ.get"):
+            self._emit("trace-env-read", node,
+                       "'%s' inside a traced function is baked in at "
+                       "trace time (recompile hazard)" % fn)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        self.generic_visit(node)
+        if _dotted(node.value) == "os.environ":
+            self._emit("trace-env-read", node,
+                       "'os.environ[...]' inside a traced function is "
+                       "baked in at trace time (recompile hazard)")
+
+    # -- python control flow on tracers ---------------------------------
+    def _check_test(self, node, test):
+        bad = self._tainted_names(test)
+        if bad:
+            self._emit("trace-python-branch", node,
+                       "Python branch on traced value(s) %s — each "
+                       "path recompiles; use jnp.where/lax.cond"
+                       % sorted(bad))
+
+    def visit_If(self, node: ast.If):
+        self._check_test(node, node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While):
+        self._check_test(node, node.test)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp):
+        self._check_test(node, node.test)
+        self.generic_visit(node)
+
+    # nested defs inherit taint via closure — lint them too
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        if node is self.fn:
+            self.generic_visit(node)
+            return
+        sub = _TracedBodyLinter(self.path, node, self.tainted,
+                                self.findings)
+        params = [a.arg for a in node.args.posonlyargs + node.args.args]
+        kwparams = [a.arg for a in node.args.kwonlyargs]
+        # param taint comes from actual call sites; a function passed
+        # by reference (lax.scan body, jax.grad target, ...) gets its
+        # tracer arguments from jax, so everything is tainted
+        call_funcs, calls, referenced = set(), [], False
+        for n in ast.walk(self.fn):
+            if isinstance(n, ast.Call):
+                call_funcs.add(id(n.func))
+                if isinstance(n.func, ast.Name) \
+                        and n.func.id == node.name:
+                    calls.append(n)
+        for n in ast.walk(self.fn):
+            if isinstance(n, ast.Name) and n.id == node.name \
+                    and isinstance(n.ctx, ast.Load) \
+                    and id(n) not in call_funcs:
+                referenced = True
+        tainted_params: Set[str] = set()
+        if referenced or not calls:
+            tainted_params = set(params) | set(kwparams)
+        else:
+            for c in calls:
+                for i, a in enumerate(c.args):
+                    if i < len(params) and self._tainted_names(a):
+                        tainted_params.add(params[i])
+                for kw in c.keywords:
+                    if kw.arg and self._tainted_names(kw.value):
+                        tainted_params.add(kw.arg)
+        for p in params + kwparams:
+            if p in tainted_params:
+                sub.tainted.add(p)
+            else:  # param shadows any tainted closure name
+                sub.tainted.discard(p)
+        for stmt in node.body:
+            sub.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _collect_traced_functions(tree: ast.Module):
+    """(def node, static_names) for every function traced under jit."""
+    # names passed to jax.jit(...) anywhere
+    jit_by_name: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            info = _jit_call_info(node)
+            if info is None:
+                continue
+            fn_arg, static, static_names, _donate = info
+            if isinstance(fn_arg, ast.Name):
+                jit_by_name[fn_arg.id] = (static, static_names)
+
+    traced = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        static: Set[int] = set()
+        static_names: Set[str] = set()
+        is_traced = False
+        for dec in node.decorator_list:
+            if _is_jit_expr(dec):
+                is_traced = True
+                if isinstance(dec, ast.Call):
+                    info = _jit_call_info(dec)
+                    if info is None and _dotted(dec.func) in (
+                            "partial", "functools.partial"):
+                        for kw in dec.keywords:
+                            if kw.arg == "static_argnums":
+                                static |= set(_int_tuple(kw.value))
+                            elif kw.arg == "static_argnames":
+                                static_names |= set(
+                                    _str_tuple(kw.value))
+                    elif info is not None:
+                        static |= info[1]
+                        static_names |= info[2]
+        if node.name in jit_by_name:
+            is_traced = True
+            s, sn = jit_by_name[node.name]
+            static |= s
+            static_names |= sn
+        if is_traced:
+            traced.append((node, static, static_names))
+    return traced
+
+
+def _lint_donated_reuse(path: str, tree: ast.Module,
+                        findings: List[Finding]):
+    """Flag reads of a bare-name donated argument after the jitted call."""
+    # donating callables: name/attr assigned from jax.jit(..., donate_...)
+    donators: Dict[str, Set[int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            info = _jit_call_info(node.value)
+            if info is None or not info[3]:
+                continue
+            for tgt in node.targets:
+                d = _dotted(tgt)
+                if d:
+                    donators[d] = set(info[3])
+
+    if not donators:
+        return
+
+    def scan_body(body):
+        # name -> line where it became garbage
+        donated: Dict[str, int] = {}
+        for stmt in body:
+            # reads in this statement, before processing its own call
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Name) and isinstance(n.ctx,
+                                                          ast.Load) \
+                        and n.id in donated:
+                    call_line = donated[n.id]
+                    # the donating call statement itself is exempt
+                    if n.lineno > call_line:
+                        findings.append(Finding(
+                            rule="trace-donated-reuse",
+                            message="'%s' was donated at line %d and "
+                                    "its buffer is dead; reassign "
+                                    "before reuse" % (n.id, call_line),
+                            file=path, line=n.lineno))
+                        del donated[n.id]
+                        break
+            # new donations from calls in this statement — recorded
+            # BEFORE the reassignment check below, because in
+            # ``p = step(p, g)`` the call consumes the old buffer and
+            # the assignment rebinds ``p`` to the fresh one
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call):
+                    d = _dotted(n.func)
+                    if d in donators:
+                        for pos in donators[d]:
+                            if pos < len(n.args) and isinstance(
+                                    n.args[pos], ast.Name):
+                                donated[n.args[pos].id] = n.lineno
+            # reassignment clears the poison
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            donated.pop(n.id, None)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                t = stmt.target
+                if isinstance(t, ast.Name):
+                    donated.pop(t.id, None)
+        # names still poisoned at body end are fine (scope ends)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_body(node.body)
+
+
+def lint_tree(path: str, tree: ast.Module) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn, static, static_names in _collect_traced_functions(tree):
+        args = fn.args
+        tainted = {a.arg for a in args.args + args.kwonlyargs
+                   + args.posonlyargs}
+        if args.vararg:
+            tainted.add(args.vararg.arg)
+        tainted.discard("self")
+        # static args are concrete python values, not tracers
+        all_pos = [a.arg for a in args.posonlyargs + args.args]
+        for i in static:
+            if 0 <= i < len(all_pos):
+                tainted.discard(all_pos[i])
+        tainted -= static_names
+        linter = _TracedBodyLinter(path, fn, tainted, findings)
+        for stmt in fn.body:
+            linter.visit(stmt)
+    _lint_donated_reuse(path, tree, findings)
+    return findings
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, "r") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="trace-parse-error",
+                        message="cannot parse: %s" % e, file=path,
+                        line=getattr(e, "lineno", 1) or 1)]
+    return lint_tree(path, tree)

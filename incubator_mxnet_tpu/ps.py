@@ -204,9 +204,19 @@ class _ConnPool:
             timeout: float = 120.0) -> Any:
         with self._lock:
             entry = self._conns.get(addr)
-            if entry is None:
-                entry = (_connect(addr, timeout), threading.Lock())
-                self._conns[addr] = entry
+        if entry is None:
+            # connect OUTSIDE the pool lock: one unreachable peer must
+            # not stall every other peer's push/pull for `timeout`
+            # (lock-held-blocking true positive from tools/lint.py)
+            sock = _connect(addr, timeout)
+            with self._lock:
+                entry = self._conns.setdefault(
+                    addr, (sock, threading.Lock()))
+            if entry[0] is not sock:  # lost the race; keep the winner
+                try:
+                    sock.close()
+                except OSError:
+                    pass
         sock, lk = entry
         with lk:
             try:

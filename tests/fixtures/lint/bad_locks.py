@@ -1,0 +1,36 @@
+"""Seeded lock-discipline violations for tests/test_analysis.py.
+
+Never imported — parsed by the static lock checker only.
+"""
+import queue
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+        self.q = queue.Queue()
+
+    def ab(self):
+        with self.a:
+            with self.b:  # SEED:ab
+                return 1
+
+    def ba(self):
+        with self.b:
+            with self.a:  # SEED:ba
+                return 2
+
+    def drain(self):
+        with self.a:
+            return self.q.get()  # SEED:blocking
+
+    def helper_takes_b(self):
+        with self.b:  # SEED:via-helper
+            return 3
+
+    def a_then_helper(self):
+        # the edge a -> b must also be found through the method call
+        with self.a:
+            return self.helper_takes_b()

@@ -1,0 +1,42 @@
+"""Seeded tracing-hazard violations for tests/test_analysis.py.
+
+Never imported — parsed by the AST lint only.  Each violation carries a
+``SEED:<tag>`` marker comment the test resolves to a line number.
+"""
+import os
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def leaky(x, y):
+    lr = float(os.environ.get("TP_LR", "0.1"))  # SEED:env
+    v = x.sum()
+    host = v.item()  # SEED:item
+    if y > 0:  # SEED:branch
+        y = y + host
+    z = np.asarray(y)  # SEED:asarray
+    return z * lr
+
+
+@jax.jit
+def shape_branch_is_fine(x):
+    # static metadata: no finding expected on this branch
+    if x.ndim > 1:  # SEED:ok-branch
+        x = x.reshape((x.shape[0], -1))
+    return x.sum()
+
+
+step = jax.jit(lambda p, g: p - 0.1 * g, donate_argnums=(0,))
+
+
+def train(p, g):
+    new_p = step(p, g)
+    stale = p + 1.0  # SEED:donated
+    return new_p, stale
+
+
+def train_ok(p, g):
+    p = step(p, g)  # reassignment makes reuse safe
+    return p + 1.0  # SEED:ok-donated

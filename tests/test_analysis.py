@@ -1,0 +1,386 @@
+"""Static-analysis suite (``incubator_mxnet_tpu.analysis``) — ISSUE 8.
+
+Each pass must (a) catch its seeded fixture violations WITH provenance
+(file:line for the AST passes, node names for the graph verifier) and
+(b) report zero findings on the repo itself (the tier-1 subset checks
+the cheap passes; the full sweep incl. the jax-backed graph pass runs
+under ``@slow`` and in the ``tools/check.py`` gate).
+"""
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.analysis import (
+    analyze_lock_files, check_env_drift, filter_suppressed,
+    install_runtime_checker, lint_tracing_file, load_suppressions,
+    uninstall_runtime_checker, verify_graph)
+from incubator_mxnet_tpu.base import MXNetError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+
+def _seed_lines(path):
+    """Map SEED:<tag> marker comments to their line numbers."""
+    out = {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            if "SEED:" in line:
+                out[line.split("SEED:")[1].strip()] = lineno
+    return out
+
+
+def _by_rule(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.rule, []).append(f)
+    return out
+
+
+# =====================================================================
+# graph verifier
+# =====================================================================
+
+def test_graph_clean_model_has_no_findings():
+    net = mx.models.mlp()
+    assert verify_graph(net, shapes={"data": (32, 784),
+                                     "softmax_label": (32,)}) == []
+
+
+def test_graph_dtype_mismatch_edge():
+    a = mx.sym.Variable("a", dtype="float32")
+    b = mx.sym.Variable("b", dtype="float16")
+    out = mx.sym.elemwise_add(a, b, name="join")
+    fs = _by_rule(verify_graph(out, shapes={"a": (4,), "b": (4,)}))
+    (f,) = fs["graph-dtype-mismatch"]
+    assert f.node == "join"          # node provenance
+    assert "float16" in f.message and "float32" in f.message
+
+
+def test_graph_dangling_input_and_duplicate_name():
+    from incubator_mxnet_tpu.symbol import Symbol, Variable
+
+    v = Variable("x")._outputs[0][0]
+    fc = mx.sym.FullyConnected(Variable("x"), num_hidden=4,
+                               name="fc")._outputs[0][0]
+    # edge referencing output 3 of a single-output node
+    fc.inputs[0] = (v, 3)
+    fs = _by_rule(verify_graph(Symbol([(fc, 0)])))
+    assert any("output 3" in f.message
+               for f in fs["graph-dangling-input"])
+
+    dup1 = mx.sym.FullyConnected(Variable("x"), num_hidden=4,
+                                 name="same")
+    dup2 = mx.sym.FullyConnected(dup1, num_hidden=4, name="same")
+    fs = _by_rule(verify_graph(dup2))
+    assert any("appears 2 times" in f.message
+               for f in fs["graph-dangling-input"])
+
+
+def test_graph_unused_output_warning():
+    x = mx.sym.Variable("data")
+    split = mx.sym.SliceChannel(x, num_outputs=3, name="split")
+    # consume only output 0 — outputs 1, 2 dangle
+    head = mx.sym.Activation(split[0], act_type="relu", name="act")
+    fs = _by_rule(verify_graph(head, shapes={"data": (2, 6)}))
+    msgs = [f.message for f in fs["graph-unused-output"]]
+    assert len(msgs) == 2 and all("split" in m for m in msgs)
+    assert all(f.severity == "warning"
+               for f in fs["graph-unused-output"])
+
+
+def test_graph_shape_error_names_node():
+    x = mx.sym.Variable("data")
+    bad = mx.sym.Reshape(x, shape=(7, 13), name="impossible")
+    fs = _by_rule(verify_graph(bad, shapes={"data": (4, 4)}))
+    assert any(f.node == "impossible"
+               for f in fs["graph-shape-error"])
+
+
+def test_graph_spec_validation():
+    net = mx.models.mlp()
+    shapes = {"data": (32, 784), "softmax_label": (32,)}
+    # clean: batch sharded over dp divides 32
+    assert verify_graph(net, shapes=shapes, mesh_axes={"dp": 8},
+                        specs={"data": ("dp", None)}) == []
+    # unknown axis + indivisible batch + over-rank spec
+    fs = _by_rule(verify_graph(
+        net, shapes=shapes, mesh_axes={"dp": 8},
+        specs={"data": ("mp", None),
+               "softmax_label": ("dp", None, None)}))
+    assert "graph-spec-unknown-axis" in fs
+    assert "graph-spec-rank" in fs
+    fs = _by_rule(verify_graph(net, shapes=shapes,
+                               mesh_axes={"dp": 5},
+                               specs={"data": ("dp", None)}))
+    assert "graph-spec-indivisible" in fs
+
+
+def test_graph_spec_conflict_and_allgather():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    join = mx.sym.elemwise_add(a, b, name="join")
+    fs = _by_rule(verify_graph(
+        join, shapes={"a": (8, 4), "b": (8, 4)}, mesh_axes={"dp": 4},
+        specs={"a": ("dp", None), "b": (None, "dp")}))
+    assert any(f.node == "join" for f in fs["graph-spec-conflict"])
+
+    # contraction over a sharded feature dim forces an all-gather
+    x = mx.sym.Variable("x")
+    fc = mx.sym.FullyConnected(x, num_hidden=3, name="fc")
+    fs = _by_rule(verify_graph(fc, shapes={"x": (8, 16)},
+                               mesh_axes={"mp": 4},
+                               specs={"x": (None, "mp")}))
+    assert any(f.node == "fc"
+               for f in fs["graph-implicit-allgather"])
+
+
+# =====================================================================
+# tracing-hazard lint
+# =====================================================================
+
+def test_tracing_fixture_catches_seeded_violations():
+    path = os.path.join(FIXTURES, "bad_tracing.py")
+    seeds = _seed_lines(path)
+    fs = lint_tracing_file(path)
+    got = {(f.rule, f.line) for f in fs}
+    assert ("trace-env-read", seeds["env"]) in got
+    assert ("trace-host-sync", seeds["item"]) in got
+    assert ("trace-python-branch", seeds["branch"]) in got
+    assert ("trace-host-sync", seeds["asarray"]) in got
+    assert ("trace-donated-reuse", seeds["donated"]) in got
+    assert all(f.file == path for f in fs)  # file provenance
+    # the static-metadata branch and the reassigned donation are clean
+    lines = {f.line for f in fs}
+    assert seeds["ok-branch"] not in lines
+    assert seeds["ok-donated"] not in lines
+
+
+def test_tracing_ignores_untraced_functions(tmp_path):
+    p = tmp_path / "plain.py"
+    p.write_text("def f(x):\n"
+                 "    return float(x.sum().item())\n")
+    assert lint_tracing_file(str(p)) == []
+
+
+# =====================================================================
+# lock checker — static
+# =====================================================================
+
+def test_lock_fixture_ab_ba_inversion_reported():
+    path = os.path.join(FIXTURES, "bad_locks.py")
+    seeds = _seed_lines(path)
+    fs, graph = analyze_lock_files([path])
+    by = _by_rule(fs)
+    (cycle,) = by["lock-order-cycle"]
+    assert "Inverted.a" in cycle.message \
+        and "Inverted.b" in cycle.message
+    assert ":%d" % seeds["ab"] in cycle.message \
+        and ":%d" % seeds["ba"] in cycle.message  # both sites named
+    # queue.get under a held lock
+    assert any(f.line == seeds["blocking"]
+               for f in by["lock-held-blocking"])
+    # the a->b edge discovered through the helper method call
+    assert ("Inverted.a", "Inverted.b") in graph.edges
+
+
+def test_lock_static_pass_clean_on_threaded_modules():
+    mods = ["serving/engine.py", "serving/generate.py", "io.py",
+            "resilience/manager.py", "ps.py"]
+    paths = [os.path.join(REPO, "incubator_mxnet_tpu", m)
+             for m in mods]
+    findings, _ = analyze_lock_files(paths)
+    assert filter_suppressed(findings) == []
+
+
+# =====================================================================
+# lock checker — runtime (TP_LOCK_CHECK)
+# =====================================================================
+
+@pytest.fixture
+def runtime_checker():
+    install_runtime_checker()
+    try:
+        yield
+    finally:
+        uninstall_runtime_checker()
+
+
+def test_runtime_ab_ba_raises(runtime_checker):
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    with pytest.raises(MXNetError, match="inversion"):
+        with b:
+            with a:
+                pass
+
+
+def test_runtime_queue_wait_under_lock_raises(runtime_checker):
+    import queue
+
+    lock = threading.Lock()
+    q = queue.Queue()
+    with pytest.raises(MXNetError, match="Queue.get"):
+        with lock:
+            q.get()
+    q.put(1)
+    assert q.get(timeout=1) == 1  # timeout'd wait stays legal
+
+
+def test_runtime_condition_wait_releases(runtime_checker):
+    import time
+
+    cond = threading.Condition()
+    woke = []
+
+    def waiter():
+        with cond:
+            woke.append(cond.wait(timeout=5))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    with cond:  # if wait() kept the lock "held" this would deadlock
+        cond.notify()
+    t.join(timeout=5)
+    assert woke == [True]
+
+
+def test_engine_batcher_death_under_runtime_checker(runtime_checker):
+    """Satellite audit: submit/slice-back AND the batcher-death path
+    (batch fn raising) run clean with the lock checker armed — locks
+    are acquired in one global order and futures still resolve."""
+    from incubator_mxnet_tpu.serving.engine import InferenceEngine
+
+    with InferenceEngine(lambda b: [b["x"] * 2.0], max_batch=4,
+                         max_delay_ms=5.0) as eng:
+        futs = [eng.submit({"x": np.full((2,), i, np.float32)})
+                for i in range(5)]
+        for i, f in enumerate(futs):
+            np.testing.assert_allclose(f.result(timeout=30)[0],
+                                       np.full((2,), 2.0 * i))
+
+    def boom(_batch):
+        raise RuntimeError("injected batch failure")
+
+    eng = InferenceEngine(boom, max_batch=2, max_delay_ms=0.0)
+    fut = eng.submit({"x": np.ones((2,), np.float32)})
+    with pytest.raises(Exception, match="injected batch failure"):
+        fut.result(timeout=30)
+    eng.close()
+
+
+def test_ckpt_writer_shutdown_under_runtime_checker(runtime_checker,
+                                                    tmp_path):
+    """Satellite audit: async save + writer shutdown (close → queue
+    join) with the lock checker armed — no held-lock queue waits."""
+    from incubator_mxnet_tpu.resilience.manager import CheckpointManager
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                name="fc")
+    mod = mx.mod.Module(mx.sym.LinearRegressionOutput(
+        net, mx.sym.Variable("label"), name="out"), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 3))],
+             label_shapes=[("label", (2, 2))])
+    mod.init_params(mx.initializer.Xavier())
+
+    cm = CheckpointManager(str(tmp_path), every_n_steps=1)
+    cm.step_end(mod, 1)
+    cm.step_end(mod, 2)
+    cm.wait()
+    cm.close()
+    assert cm.committed_steps() == [1, 2]
+
+
+# =====================================================================
+# env drift
+# =====================================================================
+
+def test_env_drift_fixture(tmp_path):
+    pkg = tmp_path / "incubator_mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "from .base import get_env\n"
+        "import os\n"
+        "a = get_env('ALPHA', 1, int)\n"
+        "b = os.environ.get('TP_BETA')\n"
+        "c = os.environ.get('TP_BENCH_CUSTOM')\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "env_var.md").write_text(
+        "| `TP_ALPHA` | 1 | alpha |\n"
+        "| `TP_GAMMA` | — | documented but never read |\n"
+        "`TP_BENCH_*` family\n")
+    fs = _by_rule(check_env_drift(str(tmp_path)))
+    (undoc,) = fs["env-undocumented"]
+    assert "TP_BETA" in undoc.message
+    assert undoc.file.endswith("mod.py") and undoc.line == 4
+    (unread,) = fs["env-unread"]
+    assert "TP_GAMMA" in unread.message
+
+
+def test_env_drift_repo_clean():
+    assert check_env_drift(REPO) == []
+
+
+# =====================================================================
+# suppressions
+# =====================================================================
+
+def test_suppression_directive_and_justification(tmp_path):
+    p = tmp_path / "s.py"
+    p.write_text(
+        "x = 1  # tp-lint: disable=some-rule -- known-safe because X\n"
+        "# tp-lint: disable=next-line-rule -- applies below\n"
+        "y = 2\n"
+        "z = 3  # tp-lint: disable=bad-one\n")
+    supp, problems = load_suppressions(str(p))
+    assert "some-rule" in supp[1]
+    assert "next-line-rule" in supp[3]
+    (bad,) = problems
+    assert bad.rule == "lint-bad-suppression" and bad.line == 4
+
+    from incubator_mxnet_tpu.analysis import Finding
+
+    fs = [Finding(rule="some-rule", message="m", file=str(p), line=1),
+          Finding(rule="other-rule", message="m", file=str(p), line=1)]
+    kept = filter_suppressed(fs)
+    assert [f.rule for f in kept] == ["other-rule"]
+
+
+# =====================================================================
+# repo-wide CLI runs
+# =====================================================================
+
+def _run_lint(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "lint.py")] + list(args),
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+
+
+def test_repo_lint_fast_passes_clean():
+    """tracing + locks + env are pure-AST: run them in-suite."""
+    proc = _run_lint("--pass", "tracing", "--pass", "locks",
+                     "--pass", "env")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.slow
+def test_repo_lint_all_passes_clean_and_json():
+    """The full suite (incl. the jax-backed graph pass over the model
+    zoo) exits 0 with zero unsuppressed findings — the check.py gate."""
+    proc = _run_lint("--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    data = json.loads(proc.stdout)
+    assert data["count"] == 0 and data["findings"] == []

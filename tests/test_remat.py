@@ -20,8 +20,11 @@ def _conv_bn_net():
     d = mx.sym.Variable("data")
     x = d
     for i in range(2):
+        # no_bias: under BatchNorm a conv bias is analytically zero-grad,
+        # so its "gradient" is pure float noise — useless to compare
         x = mx.sym.Convolution(x, num_filter=8, kernel=(3, 3),
-                               pad=(1, 1), name="conv%d" % i)
+                               pad=(1, 1), no_bias=True,
+                               name="conv%d" % i)
         x = mx.sym.BatchNorm(x, name="bn%d" % i)
         x = mx.sym.Activation(x, act_type="relu", name="relu%d" % i)
     x = mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max",
@@ -46,9 +49,12 @@ def _grads(net, shapes, args, aux, remat):
 
 @pytest.mark.parametrize("remat", ["mirror", 2, 5])
 def test_remat_is_numerically_invisible_conv_bn(remat):
-    """Gradients AND the threaded BN aux updates are bit-identical under
-    every remat mode (conv/BN exercises aux write-back across segment
-    boundaries)."""
+    """Gradients AND the threaded BN aux updates match under every remat
+    mode (conv/BN exercises aux write-back across segment boundaries).
+    Tolerance is f32-recompute-level, not bitwise: XLA may fuse the
+    rematerialized forward differently (observed 1e-4 rel on 1/216
+    conv-weight grad elements on CPU), while a genuine remat bug — a
+    dropped segment, stale aux — shows up at O(1)."""
     net = _conv_bn_net()
     shapes = dict(data=(2, 3, 8, 8), softmax_label=(2,))
     arg_shapes, _, aux_shapes = net.infer_shape(**shapes)
@@ -65,7 +71,7 @@ def test_remat_is_numerically_invisible_conv_bn(remat):
     np.testing.assert_allclose(float(loss0), float(loss1), rtol=1e-6)
     for n in g0:
         np.testing.assert_allclose(np.asarray(g0[n]), np.asarray(g1[n]),
-                                   rtol=1e-5, atol=1e-7, err_msg=n)
+                                   rtol=1e-4, atol=1e-6, err_msg=n)
     for n in aux0:
         np.testing.assert_allclose(np.asarray(aux0[n]),
                                    np.asarray(aux1[n]),

@@ -28,7 +28,11 @@ Checks, in order:
 8. **resilience** — the fault-tolerance subset: the crash-and-resume
    A/B bit-equality, torn-save fallback, preemption final save and
    injector determinism (``tests/test_resilience.py``;
-   ``TP_CHECK_FAULT=0`` skips).
+   ``TP_CHECK_FAULT=0`` skips);
+9. **static-analysis** — the ``tools/lint.py`` suite (graph verifier
+   over the model zoo, tracing-hazard lint, lock-order checker,
+   env-knob drift; docs/static_analysis.md): zero unsuppressed
+   findings (needs jax — skip with ``TP_CHECK_LINT=0``).
 
 Exit code 0 = clean; 1 = findings (printed one per line).
 """
@@ -291,6 +295,32 @@ def check_resilience(problems):
                         + "\n  ".join(tail))
 
 
+def check_static_analysis(problems):
+    """Static-analysis gate (docs/static_analysis.md): run the full
+    ``tools/lint.py`` suite — graph verifier over the model zoo,
+    tracing-hazard lint over the package, the lock-order checker over
+    the threaded modules, and the env-knob drift pass — requiring zero
+    unsuppressed findings (needs jax — skip with ``TP_CHECK_LINT=0``)."""
+    if os.environ.get("TP_CHECK_LINT", "1") == "0":
+        return
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join("tools", "lint.py")],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        problems.append("static-analysis: lint run did not finish: %s"
+                        % e)
+        return
+    if proc.returncode != 0:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-20:]
+        problems.append("static-analysis: tools/lint.py reported "
+                        "findings:\n  " + "\n  ".join(tail))
+
+
 def main():
     problems = []
     check_compile(problems)
@@ -301,6 +331,7 @@ def main():
     check_overlap(problems)
     check_quant(problems)
     check_resilience(problems)
+    check_static_analysis(problems)
     for p in problems:
         print(p)
     print("%d file(s) checked, %d problem(s)"

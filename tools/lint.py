@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Static-analysis CLI — runs the ``incubator_mxnet_tpu.analysis``
+passes over the repo and exits non-zero on any unsuppressed finding.
+
+::
+
+    python tools/lint.py                 # all passes
+    python tools/lint.py --pass locks    # one pass
+    python tools/lint.py --json          # machine-readable findings
+
+Passes: ``graph`` (verify every model-zoo Symbol plus a data-parallel
+spec check), ``tracing`` (AST hazards in jitted code), ``locks``
+(static lock-order graph over the threaded modules), ``env``
+(``TP_*`` knob ⟷ ``docs/env_var.md`` drift).  Suppress individual
+findings in source with ``# tp-lint: disable=<rule> -- why`` (see
+``docs/static_analysis.md``).
+
+``tools/check.py`` runs this as a default-on gate (``TP_CHECK_LINT=0``
+skips).
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PASSES = ("graph", "tracing", "locks", "env")
+
+# the threaded modules the lock pass covers — modules that create
+# threading primitives and run background threads
+LOCK_MODULES = [
+    "incubator_mxnet_tpu/serving/engine.py",
+    "incubator_mxnet_tpu/serving/generate.py",
+    "incubator_mxnet_tpu/io.py",
+    "incubator_mxnet_tpu/resilience/manager.py",
+    "incubator_mxnet_tpu/resilience/faults.py",
+    "incubator_mxnet_tpu/ps.py",
+    "incubator_mxnet_tpu/telemetry.py",
+    "incubator_mxnet_tpu/overlap.py",
+    "incubator_mxnet_tpu/recordio.py",
+    "incubator_mxnet_tpu/engine.py",
+]
+
+# canonical model-zoo graphs the graph pass verifies: (name, kwargs,
+# input shapes).  Small spatial sizes keep eval_shape-based inference
+# instant while exercising the same op sequences as the real configs.
+GRAPH_CASES = [
+    ("mlp", {}, {"data": (32, 1, 28, 28), "softmax_label": (32,)}),
+    ("lenet", {}, {"data": (8, 1, 28, 28), "softmax_label": (8,)}),
+    ("alexnet", {}, {"data": (2, 3, 224, 224), "softmax_label": (2,)}),
+    ("inception-bn", {}, {"data": (2, 3, 224, 224),
+                          "softmax_label": (2,)}),
+    ("resnet", {"num_layers": 20, "image_shape": (3, 32, 32)},
+     {"data": (4, 3, 32, 32), "softmax_label": (4,)}),
+    ("transformer", {"vocab_size": 64, "embed": 32, "heads": 2,
+                     "num_layers": 2, "seq_len": 16, "batch_size": 4},
+     {"data": (4, 16), "softmax_label": (4, 16)}),
+]
+
+
+def run_graph_pass():
+    from incubator_mxnet_tpu import models
+    from incubator_mxnet_tpu.analysis import verify_graph
+    from incubator_mxnet_tpu.analysis.findings import Finding
+
+    findings = []
+    for name, kwargs, shapes in GRAPH_CASES:
+        try:
+            sym = models.get_symbol(name, **kwargs)
+        except Exception as e:  # a zoo builder crashing IS a finding
+            findings.append(Finding(
+                rule="graph-shape-error",
+                message="building zoo symbol '%s' failed: %s"
+                        % (name, e), node=name))
+            continue
+        for f in verify_graph(sym, shapes=shapes):
+            f.message = "[model %s] %s" % (name, f.message)
+            findings.append(f)
+    # data-parallel spec sanity on the mlp: batch sharded over dp must
+    # verify clean — this is the trace-time GSPMD-style check
+    sym = models.get_symbol("mlp")
+    findings.extend(verify_graph(
+        sym, shapes={"data": (32, 784), "softmax_label": (32,)},
+        mesh_axes={"dp": 8},
+        specs={"data": ("dp", None), "softmax_label": ("dp",)}))
+    return findings
+
+
+def run_tracing_pass():
+    from incubator_mxnet_tpu.analysis import lint_tracing_file
+
+    findings = []
+    pkg = os.path.join(REPO_ROOT, "incubator_mxnet_tpu")
+    for base, dirs, files in os.walk(pkg):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                findings.extend(lint_tracing_file(
+                    os.path.join(base, fn)))
+    return findings
+
+
+def run_locks_pass():
+    from incubator_mxnet_tpu.analysis import analyze_lock_files
+
+    paths = [os.path.join(REPO_ROOT, p) for p in LOCK_MODULES
+             if os.path.exists(os.path.join(REPO_ROOT, p))]
+    findings, _graph = analyze_lock_files(paths)
+    return findings
+
+
+def run_env_pass():
+    from incubator_mxnet_tpu.analysis import check_env_drift
+
+    return check_env_drift(REPO_ROOT)
+
+
+def run_suppression_audit():
+    """Malformed ``tp-lint`` directives are findings themselves."""
+    from incubator_mxnet_tpu.analysis import load_suppressions
+
+    findings = []
+    for root in ("incubator_mxnet_tpu", "tools", "examples"):
+        top = os.path.join(REPO_ROOT, root)
+        if not os.path.isdir(top):
+            continue
+        for base, dirs, files in os.walk(top):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    _, problems = load_suppressions(
+                        os.path.join(base, fn))
+                    findings.extend(problems)
+    return findings
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="incubator_mxnet_tpu static-analysis suite")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=PASSES + ("all",),
+                    help="run only this pass (repeatable); default all")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON for telemetry ingestion")
+    args = ap.parse_args(argv)
+
+    selected = set(args.passes or ["all"])
+    if "all" in selected:
+        selected = set(PASSES)
+
+    from incubator_mxnet_tpu.analysis import filter_suppressed
+
+    findings = []
+    runners = {"graph": run_graph_pass, "tracing": run_tracing_pass,
+               "locks": run_locks_pass, "env": run_env_pass}
+    for name in PASSES:
+        if name in selected:
+            findings.extend(runners[name]())
+    findings.extend(run_suppression_audit())
+    findings = filter_suppressed(findings)
+    # report repo-relative paths
+    for f in findings:
+        if f.file and os.path.isabs(f.file):
+            f.file = os.path.relpath(f.file, REPO_ROOT)
+    findings.sort(key=lambda f: (f.file or "", f.line or 0, f.rule))
+
+    if args.json:
+        print(json.dumps({"findings": [f.to_dict() for f in findings],
+                          "count": len(findings)}, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print("lint: %d finding(s) across pass(es) %s"
+              % (len(findings), ",".join(sorted(selected))))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
