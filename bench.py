@@ -295,6 +295,123 @@ def _serving_record(small):
     return record
 
 
+def _paged_serving_record(small):
+    """Paged-KV serving sub-record (docs/paged_kv.md): rectangular vs
+    paged A/B at EQUAL KV HBM under a bursty mixed-length workload with
+    a per-request deadline SLO (``TP_BENCH_SERVE_SLO_MS``) — goodput
+    counts only requests that met the SLO; the offered-load sweep takes
+    the paged engine into overload; the concurrent-slot high-water
+    ratio is the admission win; a shared-system-prompt pass shows
+    prefix-cache hits skipping prefill."""
+    from incubator_mxnet_tpu import serving
+
+    rng = np.random.RandomState(0)
+    V, E, H, NL, S = (32, 32, 4, 1, 32) if small else (512, 256, 8, 4, 256)
+    P = 16 if small else 32
+    rect_slots = 2 if small else 4
+    paged_slots = 8 if small else 16
+    new_tokens = 4 if small else 16
+    n_requests = 12 if small else 64
+    slo_ms = float(os.environ.get("TP_BENCH_SERVE_SLO_MS", "10000"))
+    # equal KV HBM: the pool holds exactly the rectangle's token-slots
+    pool_blocks = rect_slots * (S // P)
+    model = serving.KVTransformerLM(_toy_lm_params(rng, V, E, NL, S),
+                                    heads=H)
+    prompts = [rng.randint(0, V, size=int(rng.randint(1, S // 2)))
+               .astype(np.int32) for _ in range(n_requests)]
+
+    def burst(eng, reqs):
+        """Submit every request at once (the overload shape), resolve
+        per-request latency via done-callbacks, and report goodput =
+        tokens from requests that met the SLO."""
+        lats = {}
+        futs = []
+        t0 = time.perf_counter()
+        for p in reqs:
+            ts = time.perf_counter()
+            f = eng.submit(p, max_new_tokens=new_tokens,
+                           deadline_ms=slo_ms)
+            f.add_done_callback(
+                lambda f, ts=ts: lats.setdefault(
+                    f, time.perf_counter() - ts))
+            futs.append(f)
+        ok = expired = 0
+        for f in futs:
+            try:
+                f.result(timeout=600)
+                ok += 1
+            except Exception:
+                expired += 1
+        dt = time.perf_counter() - t0
+        good = [lats[f] for f in futs
+                if f in lats and f.exception() is None]
+        out = {"offered": len(reqs), "ok": ok, "expired": expired,
+               "goodput_tokens_per_sec":
+                   round(ok * new_tokens / dt, 1)}
+        if good:
+            out["p50_latency_ms"] = round(
+                float(np.percentile(good, 50)) * 1e3, 2)
+            out["p99_latency_ms"] = round(
+                float(np.percentile(good, 99)) * 1e3, 2)
+        return out
+
+    record = {"metric": "paged_serving_goodput_tokens_per_sec",
+              "unit": "tokens/s", "page_tokens": P,
+              "pool_blocks": pool_blocks, "rect_slots": rect_slots,
+              "paged_slots": paged_slots, "max_len": S,
+              "new_tokens": new_tokens, "slo_ms": slo_ms, "sweep": []}
+    ab = [prompts[i % n_requests] for i in range(n_requests)]
+    with serving.GenerationEngine(model, max_slots=rect_slots,
+                                  max_len=S) as rect:
+        rect.generate(prompts[0], max_new_tokens=2, timeout=600)
+        record["rect_equal_hbm"] = burst(rect, ab)
+        rect_hw = rect.active_high_water
+    with serving.PagedGenerationEngine(
+            model, max_slots=paged_slots, max_len=S, page_tokens=P,
+            pool_blocks=pool_blocks) as eng:
+        eng.generate(prompts[0], max_new_tokens=2, timeout=600)
+        for load in (n_requests // 2, n_requests, 2 * n_requests):
+            reqs = [prompts[i % n_requests] for i in range(load)]
+            row = burst(eng, reqs)
+            record["sweep"].append(row)
+            if load == n_requests:
+                record["paged_equal_hbm"] = row
+        record["value"] = \
+            record["paged_equal_hbm"]["goodput_tokens_per_sec"]
+        record["rect_high_water"] = rect_hw
+        record["paged_high_water"] = eng.active_high_water
+        record["slot_capacity_ratio"] = round(
+            eng.active_high_water / max(rect_hw, 1), 2)
+        # shared-system-prompt pass: sequential requests whose prompts
+        # share the same leading full pages — everything after the
+        # first hits the prefix cache and prefills only its suffix
+        hits0 = eng.pool.stats.prefix_hits
+        hit_tok0 = eng.pool.stats.prefix_hit_tokens
+        pt0 = eng.prefill_tokens
+        sys_pages = 1 if small else 3
+        sys_p = rng.randint(0, V, size=sys_pages * P + 2) \
+            .astype(np.int32)
+        n_prefix = 4 if small else 8
+        total_prompt = 0
+        for i in range(n_prefix):
+            sfx = rng.randint(0, V, size=2 + i % 3).astype(np.int32)
+            p = np.concatenate([sys_p, sfx])
+            total_prompt += p.size
+            eng.generate(p, max_new_tokens=new_tokens, timeout=600)
+        prefilled = eng.prefill_tokens - pt0
+        record["prefix"] = {
+            "requests": n_prefix,
+            "shared_prompt_tokens": int(sys_p.size),
+            "hits": eng.pool.stats.prefix_hits - hits0,
+            "hit_tokens": eng.pool.stats.prefix_hit_tokens - hit_tok0,
+            "prompt_tokens": total_prompt,
+            "prefilled_tokens": prefilled,
+            "prefill_saved_frac": round(1 - prefilled / total_prompt,
+                                        3),
+        }
+    return record
+
+
 def _quantization_record(small):
     """Quantization sub-record (docs/quantization.md): decode tokens/s
     with int8 weight-only vs f32 weights at batch 1 and batch 8 — the
@@ -600,6 +717,10 @@ def main():
     # generation under an offered-load sweep — throughput, p50/p99,
     # padding waste, and the compile count that proves the bucket bound
     combined["serving"] = _serving_record(small)
+    # paged-KV serving sub-record (docs/paged_kv.md): rect-vs-paged A/B
+    # at equal KV HBM, deadline-SLO goodput under an offered-load
+    # sweep, the slot-capacity ratio, and the prefix-cache hit pass
+    combined["paged_serving"] = _paged_serving_record(small)
     # quantization sub-record (docs/quantization.md): int8 weight-only
     # decode A/B at batch 1/8 + parked HBM weight bytes, and the same
     # flagship train step with fp8 delayed-scaling matmuls — defaults

@@ -1,10 +1,16 @@
-"""Serving subsystem: bucketed dynamic batching (:mod:`.engine`) and
-KV-cache continuous-batching generation (:mod:`.generate`).
+"""Serving subsystem: bucketed dynamic batching (:mod:`.engine`),
+KV-cache continuous-batching generation (:mod:`.generate`), and the
+paged KV cache with prefix caching (:mod:`.paged`).
 
-See docs/serving.md for the architecture and knob table."""
+See docs/serving.md and docs/paged_kv.md for the architecture and knob
+tables."""
 from .engine import InferenceEngine, bucket_batch, bucket_length
 from .generate import (GenerationEngine, GenerationResult,
                        KVTransformerLM, LMSpec)
+from .paged import (BlockPool, PagedGenerationEngine, PagedKVCache,
+                    prefix_hashes)
 
 __all__ = ["InferenceEngine", "GenerationEngine", "GenerationResult",
-           "KVTransformerLM", "LMSpec", "bucket_batch", "bucket_length"]
+           "KVTransformerLM", "LMSpec", "BlockPool", "PagedKVCache",
+           "PagedGenerationEngine", "prefix_hashes", "bucket_batch",
+           "bucket_length"]

@@ -18,7 +18,9 @@ program per (bucket, phase) instead of one per arrival pattern.
   new work with ``MXNetError`` instead of building unbounded latency —
   backpressure belongs at the edge, not in the queue.
 - Per-request deadlines: a request that waited past its deadline fails
-  fast with ``MXNetError`` and never occupies a device slot.
+  fast with ``MXNetError`` and never occupies a device slot.  All
+  deadline and flush timing uses ``time.monotonic()`` — wall clock can
+  step (NTP, suspend) and must never enter deadline math.
 
 Telemetry (``TP_TELEMETRY=1``): ``serve_queue_depth``,
 ``serve_batch_size``, ``serve_padding_waste``,
@@ -69,7 +71,7 @@ class _Pending:
         self.future = future
         self.sig = sig
         self.deadline = deadline
-        self.t_submit = time.perf_counter()
+        self.t_submit = time.monotonic()
 
 
 class ServeStats:
@@ -168,7 +170,7 @@ class InferenceEngine:
         sig = tuple(sorted((n, a.shape, str(a.dtype))
                            for n, a in arrs.items()))
         fut: Future = Future()
-        deadline = (time.perf_counter() + deadline_ms / 1e3
+        deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
         with self._cond:
             if self._worker_exc is not None:
@@ -238,7 +240,7 @@ class InferenceEngine:
         inside the lock; returns None when closed and drained."""
         while True:
             if self._queue:
-                self._expire(time.perf_counter())
+                self._expire(time.monotonic())
             if not self._queue:
                 if self._closed:
                     return None
@@ -248,7 +250,7 @@ class InferenceEngine:
             group = [p for p in self._queue if p.sig == head.sig]
             group = group[:self.max_batch]
             flush_at = head.t_submit + self.max_delay
-            now = time.perf_counter()
+            now = time.monotonic()
             if len(group) >= self.max_batch or now >= flush_at \
                     or self._closed:
                 for p in group:
@@ -302,14 +304,14 @@ class InferenceEngine:
             batch[name] = np.stack(rows, axis=0)
         key = ("forward", group[0].sig, bucket)
         self.stats.record_batch(key, n, bucket, "forward")
-        t0 = time.perf_counter()
+        t0 = time.monotonic()
         try:
             outs = [np.asarray(o) for o in self._batch_fn(batch)]
         except Exception as e:  # noqa: BLE001 — delivered per-future
             for p in group:
                 p.future.set_exception(e)
             return
-        now = time.perf_counter()
+        now = time.monotonic()
         telemetry.histogram("serve_batch_seconds").observe(now - t0)
         for i, p in enumerate(group):
             self.stats.requests += 1

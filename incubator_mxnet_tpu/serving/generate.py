@@ -21,6 +21,13 @@ style, with exactly two program families:
   program.  Finished sequences free their slot and queued prompts join
   the running batch without recompiling — continuous batching.
 
+All deadline and latency math uses ``time.monotonic()`` (never wall
+clock, which can step).  The engine's cache layout and admission policy
+are overridable hooks (``_setup_cache`` / ``_check_request`` /
+``_take_admissible`` / ``_admit`` / ``_decode_batch`` / ``_release``)
+— :mod:`.paged` subclasses them to swap the per-slot rectangle for a
+paged block pool with prefix caching without touching the loop.
+
 Numerics match the training graph op-for-op (LayerNorm f32 two-pass
 stats, FullyConnected ``x·Wᵀ+b``, max-subtract softmax attention):
 ``tests/test_serving.py`` asserts decode logits equal the full-sequence
@@ -521,7 +528,7 @@ class GenerationResult:
 class _GenPending:
     __slots__ = ("tokens", "max_new", "temperature", "top_k",
                  "stop_token", "return_logits", "deadline", "t_submit",
-                 "future")
+                 "future", "slot", "shared_tokens")
 
     def __init__(self, tokens, max_new, temperature, top_k, stop_token,
                  return_logits, deadline, future):
@@ -532,8 +539,12 @@ class _GenPending:
         self.stop_token = stop_token
         self.return_logits = return_logits
         self.deadline = deadline
-        self.t_submit = time.perf_counter()
+        self.t_submit = time.monotonic()
         self.future = future
+        # filled at admission time (paged engine: reserved slot and
+        # shared-prefix token count)
+        self.slot = None
+        self.shared_tokens = 0
 
 
 class _Seq:
@@ -588,8 +599,11 @@ class GenerationEngine:
                              else get_env("SERVE_MAX_QUEUE", 256, int))
         self.name = name
         self.stats = model.stats
-        self._cache_k, self._cache_v = model.init_cache(
-            self.max_slots, self.max_len)
+        # engine-local mirrors (ServeStats is per-model and may be
+        # shared by several engines, e.g. an A/B bench)
+        self.active_high_water = 0
+        self.prefill_tokens = 0
+        self._setup_cache()
         self._seqs: List[Optional[_Seq]] = [None] * self.max_slots
         self._lengths = np.zeros(self.max_slots, np.int32)
         self._pending: List[_GenPending] = []
@@ -600,6 +614,21 @@ class GenerationEngine:
             target=self._loop, name=name + "-decode", daemon=True)
         self._thread.start()
 
+    # ----------------------------------------------------- overridable hooks
+    def _setup_cache(self) -> None:
+        """Allocate the KV storage (hook: the paged engine swaps the
+        per-slot rectangle for a block pool)."""
+        self._cache_k, self._cache_v = self.model.init_cache(
+            self.max_slots, self.max_len)
+
+    def _check_request(self, tokens: np.ndarray, max_new: int) -> None:
+        """Reject a request that could NEVER be admitted (hook: the
+        paged engine adds a page-budget bound)."""
+        if tokens.size + max_new > self.max_len:
+            raise MXNetError(
+                "prompt (%d) + max_new_tokens (%d) exceeds the engine's "
+                "max_len (%d)" % (tokens.size, max_new, self.max_len))
+
     # ------------------------------------------------------------ client API
     def submit(self, tokens, max_new_tokens: int = 16, *,
                temperature: float = 0.0, top_k: int = 0,
@@ -609,13 +638,9 @@ class GenerationEngine:
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size < 1:
             raise MXNetError("empty prompt")
-        if tokens.size + max_new_tokens > self.max_len:
-            raise MXNetError(
-                "prompt (%d) + max_new_tokens (%d) exceeds the engine's "
-                "max_len (%d)" % (tokens.size, max_new_tokens,
-                                  self.max_len))
+        self._check_request(tokens, int(max_new_tokens))
         fut: Future = Future()
-        deadline = (time.perf_counter() + deadline_ms / 1e3
+        deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
         req = _GenPending(tokens, int(max_new_tokens), temperature,
                           int(top_k), stop_token, return_logits,
@@ -687,7 +712,7 @@ class GenerationEngine:
     def _loop(self) -> None:
         while True:
             with self._cond:
-                self._expire_pending(time.perf_counter())
+                self._expire_pending(time.monotonic())
                 has_work = (self._pending
                             and self.active_slots < self.max_slots) \
                     or self.active_slots > 0
@@ -719,9 +744,20 @@ class GenerationEngine:
     def _fail_all(self, exc: Exception) -> None:
         for i, seq in enumerate(self._seqs):
             if seq is not None:
+                # release BEFORE failing the future: a waiter woken by
+                # the exception must observe the slot/pages as free
+                self._release(i)
                 seq.req.future.set_exception(exc)
-                self._seqs[i] = None
-                self._lengths[i] = 0
+
+    def _release(self, slot: int) -> None:
+        """Free a slot (hook: the paged engine also returns its KV
+        pages to the pool).  Zeroing the mask length is the stale-KV
+        invalidation: the decode mask is ``position < length``, so a
+        recycled slot can never attend the previous occupant's K/V —
+        whatever bytes remain in the cache are unreachable until
+        overwritten."""
+        self._seqs[slot] = None
+        self._lengths[slot] = 0
 
     # -------------------------------------------------------------- admit
     def _admit(self, reqs: List[_GenPending]) -> None:
@@ -747,11 +783,14 @@ class GenerationEngine:
                     toks[j, :r.tokens.size] = r.tokens
                     lens[j] = r.tokens.size
                     slots[j] = free[j]
+                    self.prefill_tokens += int(r.tokens.size)
+                telemetry.counter("serve_prefill_tokens_total").inc(
+                    int(sum(r.tokens.size for r in chunk)))
                 self._cache_k, self._cache_v, logits = \
                     self.model.prefill(self._cache_k, self._cache_v,
                                        toks, lens, slots)
                 logits = np.asarray(logits)
-                now = time.perf_counter()
+                now = time.monotonic()
                 for j, r in enumerate(chunk):
                     seq = _Seq(r, free[j], r.tokens.size)
                     self._seqs[free[j]] = seq
@@ -788,13 +827,12 @@ class GenerationEngine:
             np.stack(seq.logits) if seq.logits else None,
             seq.req.tokens.size, seq.slot,
             seq.t_first - seq.req.t_submit)
-        self._seqs[seq.slot] = None
-        self._lengths[seq.slot] = 0
+        self._release(seq.slot)
         self.stats.requests += 1
         telemetry.counter("serve_requests_total").inc()
         telemetry.counter("serve_slot_recycles_total").inc()
         telemetry.histogram("serve_request_seconds").observe(
-            time.perf_counter() - seq.req.t_submit)
+            time.monotonic() - seq.req.t_submit)
         seq.req.future.set_result(res)
 
     # -------------------------------------------------------------- decode
@@ -808,11 +846,11 @@ class GenerationEngine:
                 active.append(seq)
         if not active:
             return
+        self.active_high_water = max(self.active_high_water,
+                                     len(active))
         telemetry.histogram("serve_decode_active").observe(len(active))
-        self._cache_k, self._cache_v, logits = self.model.decode(
-            self._cache_k, self._cache_v, tokens, self._lengths)
-        logits = np.asarray(logits)
-        now = time.perf_counter()
+        logits = np.asarray(self._decode_batch(tokens))
+        now = time.monotonic()
         for seq in active:
             # the decode wrote this token's K/V at position `length`
             seq.length += 1
@@ -824,3 +862,10 @@ class GenerationEngine:
                     and seq.req.deadline is not None
                     and now > seq.req.deadline):
                 self._finish(seq)
+
+    def _decode_batch(self, tokens: np.ndarray):
+        """Run the one-decode program over the slot batch (hook: the
+        paged engine gathers through its block tables instead)."""
+        self._cache_k, self._cache_v, logits = self.model.decode(
+            self._cache_k, self._cache_v, tokens, self._lengths)
+        return logits

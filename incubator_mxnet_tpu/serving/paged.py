@@ -1,0 +1,779 @@
+"""Paged KV cache with prefix caching: block-pool memory management
+for the serving engine.
+
+The PR 5 engine (``generate.py``) gives every slot a max-length
+rectangle, so concurrency is bounded by the WORST-CASE sequence length
+rather than by actual HBM use.  This module replaces the rectangle with
+the vLLM/PagedAttention block-table design (Kwon et al., 2023), adapted
+to this repo's one-compiled-decode contract:
+
+- **Block pool** — one device-resident pool of ``TP_SERVE_KV_POOL_BLOCKS``
+  fixed-size pages of ``TP_SERVE_PAGE_TOKENS`` tokens each (+ one
+  scratch page absorbing padded writes).  A sequence owns
+  ``ceil((prompt + max_new) / page)`` pages instead of ``max_len``, so
+  at equal HBM budget the pool admits strictly more concurrent
+  mixed-length sequences than the rectangle.
+- **Page tables** — each slot owns one row of a padded, fixed-shape
+  ``(max_slots, max_pages)`` table; unowned entries point at the
+  scratch page.  Decode gathers every slot's pages through the table
+  into the SAME rectangular view the PR 5 decode attends over, so
+  decode stays ONE compiled program and greedy tokens stay bit-exact
+  (``tests/test_paged_kv.py``).
+- **Prefix caching** — completed FULL prompt pages are content-
+  addressed by a rolling token hash (page ``i``'s digest commits to
+  pages ``0..i``).  A new prompt sharing a cached prefix takes
+  references on those pages and prefills only its suffix — the shared
+  blocks skip prefill entirely (``serve_prefix_hits_total``, TTFT).
+  Refcount-0 cached pages park in an LRU and are reclaimed LRU-first
+  when the free list runs dry; copy-on-write diverges a shared page
+  before any write could reach it (by construction decode writes
+  always land past the shared prefix, so CoW is a defended invariant,
+  not a hot path).
+- **Admission by free pages** — :class:`PagedGenerationEngine` admits a
+  request only when slot AND page budget are reservable up front
+  (worst case, so decode can never deadlock on allocation mid-flight);
+  expired or failed requests release their reservation before the
+  future resolves.
+
+Telemetry: ``serve_kv_pages_free`` / ``serve_kv_pages_used`` /
+``serve_kv_pages_cached`` / ``serve_kv_pool_bytes`` gauges,
+``serve_prefix_hits_total`` / ``serve_prefix_hit_tokens_total`` /
+``serve_prefix_evictions_total`` / ``serve_kv_cow_total`` counters.
+See docs/paged_kv.md for the block math and eviction policy.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from ..base import MXNetError, get_env
+from .engine import bucket_batch, bucket_length
+from .generate import GenerationEngine, KVTransformerLM, _GenPending, \
+    _ln, _Seq
+
+__all__ = ["BlockPool", "PagedKVCache", "PagedGenerationEngine",
+           "prefix_hashes"]
+
+_HASH_SEED = b"tp-paged-prefix-v1"
+
+
+def prefix_hashes(tokens, page_tokens: int) -> List[bytes]:
+    """Rolling content hash per FULL page of ``tokens``: page ``i``'s
+    digest commits to every token of pages ``0..i``, so equal digests
+    mean equal whole prefixes, and a chain walk stops at the first
+    divergent page."""
+    toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+    out: List[bytes] = []
+    h = _HASH_SEED
+    for i in range(toks.size // page_tokens):
+        page = toks[i * page_tokens:(i + 1) * page_tokens]
+        h = hashlib.blake2b(h + page.tobytes(), digest_size=16).digest()
+        out.append(h)
+    return out
+
+
+class PoolStats:
+    """Host-side mirror of the pool telemetry (always on, so tests and
+    benches read it without enabling the global registry).  Mutated
+    only under the pool lock."""
+
+    __slots__ = ("prefix_hits", "prefix_hit_tokens", "prefix_misses",
+                 "evictions", "cow_copies", "allocs", "frees")
+
+    def __init__(self):
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_misses = 0
+        self.evictions = 0
+        self.cow_copies = 0
+        self.allocs = 0
+        self.frees = 0
+
+
+class BlockPool:
+    """Refcounted allocator over a fixed set of KV pages.
+
+    Thread-safe: one lock, every method a short critical section that
+    never calls out while holding it.  A block is always in exactly one
+    of three states:
+
+    - **free** — on the free list (refcount 0, no hash);
+    - **live** — refcount ≥ 1, owned by one or more slots (a shared
+      prefix block is live with refcount = number of sharers);
+    - **cached** — refcount 0 but still content-addressed: a future
+      prompt can revive it by hash (:meth:`share`), and :meth:`alloc`
+      reclaims cached blocks LRU-first when the free list runs dry.
+    """
+
+    def __init__(self, num_blocks: int, page_tokens: int):
+        if num_blocks < 1:
+            raise MXNetError("BlockPool needs >= 1 block, got %d"
+                             % num_blocks)
+        self.num_blocks = int(num_blocks)
+        self.page_tokens = int(page_tokens)
+        self.lock = threading.Lock()
+        self.stats = PoolStats()
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._ref = np.zeros(num_blocks, np.int64)
+        self._hash_of: Dict[int, bytes] = {}
+        self._block_of: Dict[bytes, int] = {}
+        # insertion order = LRU order of cached (refcount-0) blocks
+        self._lru: Dict[int, None] = {}
+        with self.lock:
+            self._gauges()
+
+    # ------------------------------------------------------------ accounting
+    def _gauges(self) -> None:
+        """Refresh the pool occupancy gauges (call under the lock)."""
+        telemetry.gauge("serve_kv_pages_free").set(len(self._free))
+        telemetry.gauge("serve_kv_pages_cached").set(len(self._lru))
+        telemetry.gauge("serve_kv_pages_used").set(
+            self.num_blocks - len(self._free) - len(self._lru))
+
+    def available(self) -> int:
+        """Pages an :meth:`alloc` could deliver right now (free +
+        cached-evictable)."""
+        with self.lock:
+            return len(self._free) + len(self._lru)
+
+    def free_blocks(self) -> int:
+        with self.lock:
+            return len(self._free)
+
+    def cached_blocks(self) -> int:
+        with self.lock:
+            return len(self._lru)
+
+    def used_blocks(self) -> int:
+        with self.lock:
+            return self.num_blocks - len(self._free) - len(self._lru)
+
+    def refcount(self, blk: int) -> int:
+        with self.lock:
+            return int(self._ref[blk])
+
+    # ------------------------------------------------------------- lifecycle
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Take ``n`` fresh private blocks (refcount 1, unhashed),
+        evicting cached prefix blocks LRU-first when the free list runs
+        dry.  Returns None — and allocates nothing — when even eviction
+        cannot cover the request (the caller defers admission)."""
+        with self.lock:
+            if n > len(self._free) + len(self._lru):
+                return None
+            evicted = 0
+            while len(self._free) < n:
+                blk = next(iter(self._lru))  # oldest cached block
+                del self._lru[blk]
+                del self._block_of[self._hash_of.pop(blk)]
+                self._free.append(blk)
+                evicted += 1
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+            self.stats.allocs += n
+            self.stats.evictions += evicted
+            self._gauges()
+        if evicted:
+            telemetry.counter("serve_prefix_evictions_total").inc(evicted)
+        return out
+
+    def share(self, digest: bytes) -> Optional[int]:
+        """Look up a prefix block by content hash; on a hit, take a
+        reference (reviving a cached block from the LRU)."""
+        with self.lock:
+            blk = self._block_of.get(digest)
+            if blk is None:
+                self.stats.prefix_misses += 1
+                return None
+            self._ref[blk] += 1
+            self._lru.pop(blk, None)
+            self.stats.prefix_hits += 1
+            self.stats.prefix_hit_tokens += self.page_tokens
+            self._gauges()
+        telemetry.counter("serve_prefix_hits_total").inc()
+        telemetry.counter("serve_prefix_hit_tokens_total").inc(
+            self.page_tokens)
+        return blk
+
+    def register(self, blk: int, digest: bytes) -> None:
+        """Content-address a live block (a completed FULL prefill
+        page).  First writer wins: if the digest is already mapped (two
+        identical prompts prefilled in one batch), the later block just
+        stays private."""
+        with self.lock:
+            if self._ref[blk] <= 0:
+                raise MXNetError(
+                    "register of non-live KV page %d" % blk)
+            if digest in self._block_of or blk in self._hash_of:
+                return
+            self._block_of[digest] = blk
+            self._hash_of[blk] = digest
+
+    def release(self, blocks) -> None:
+        """Drop one reference per block.  At refcount 0 a hashed block
+        parks in the LRU (cached — still shareable, reclaimable);
+        an unhashed block returns to the free list.  Releasing a
+        refcount-0 block (double free) raises."""
+        with self.lock:
+            for blk in blocks:
+                if self._ref[blk] <= 0:
+                    raise MXNetError(
+                        "double free of KV page %d (refcount already 0)"
+                        % blk)
+                self._ref[blk] -= 1
+                if self._ref[blk] == 0:
+                    if blk in self._hash_of:
+                        self._lru[blk] = None  # most-recently released
+                    else:
+                        self._free.append(blk)
+                self.stats.frees += 1
+            self._gauges()
+
+    def make_private(self, blk: int) -> Tuple[int, bool]:
+        """Copy-on-write bookkeeping: return a block the caller may
+        write.  A refcount-1 unhashed block comes back as-is; a
+        refcount-1 hashed block is un-registered (exclusive owner —
+        cheaper than copying); a shared block is swapped for a fresh
+        one with the old reference dropped, and the caller must copy
+        the page contents on device.  Returns ``(block, needs_copy)``.
+        """
+        with self.lock:
+            if self._ref[blk] <= 0:
+                raise MXNetError(
+                    "make_private of non-live KV page %d" % blk)
+            if self._ref[blk] == 1:
+                h = self._hash_of.pop(blk, None)
+                if h is not None:
+                    del self._block_of[h]
+                return blk, False
+        fresh = self.alloc(1)
+        if fresh is None:
+            raise MXNetError("KV page pool exhausted during copy-on-"
+                             "write divergence")
+        self.release([blk])
+        with self.lock:
+            self.stats.cow_copies += 1
+        telemetry.counter("serve_kv_cow_total").inc()
+        return fresh[0], True
+
+
+class PagedKVCache:
+    """Device-resident paged KV store for a :class:`KVTransformerLM`.
+
+    The cache is a pair of ``(num_blocks + 1, layers, heads,
+    page_tokens, head_dim)`` arrays — block-major, with the scratch
+    block at index ``num_blocks`` absorbing padded writes (the paged
+    analog of the rectangular engine's scratch slot).  Each slot owns a
+    row of the host-side ``(max_slots, max_pages)`` page table; token
+    page ``p`` of a slot (positions ``[p*P, (p+1)*P)``) lives in pool
+    block ``tables[slot, p]``.
+
+    Compiled programs (keys recorded in ``model.stats``):
+
+    - ``("paged_prefill", N, L)`` per (batch-bucket, suffix-length-
+      bucket): runs only the prompt SUFFIX past the shared prefix;
+      attention over gathered prefix pages + causal self-attention in
+      one softmax, K/V scattered whole-page through a write table.
+    - ``("paged_decode", slots)`` — ONE program ever: gathers every
+      slot's pages into the same rectangular ``(slots, layers, heads,
+      max_pages*P, head_dim)`` view the PR 5 decode attends over, and
+      scatters the new token's K/V at ``(tables[slot, len//P],
+      len % P)``.
+    """
+
+    def __init__(self, model: KVTransformerLM, max_slots: int,
+                 max_len: int, *, page_tokens: Optional[int] = None,
+                 num_blocks: Optional[int] = None):
+        import jax.numpy as jnp
+
+        from ..base import dtype_np
+
+        s = model.spec
+        if max_len > s.max_seq:
+            raise MXNetError(
+                "max_len %d exceeds the model's position table (%d)"
+                % (max_len, s.max_seq))
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        self.page_tokens = int(
+            page_tokens if page_tokens is not None
+            else get_env("SERVE_PAGE_TOKENS", 16, int))
+        if self.page_tokens < 1:
+            raise MXNetError("page_tokens must be >= 1")
+        P = self.page_tokens
+        self.max_pages = -(-self.max_len // P)
+        if num_blocks is None:
+            num_blocks = get_env("SERVE_KV_POOL_BLOCKS", 0, int) \
+                or self.max_slots * self.max_pages
+        self.num_blocks = int(num_blocks)
+        self.scratch = self.num_blocks
+        self.pool = BlockPool(self.num_blocks, P)
+        dt = dtype_np(model.kv_dtype)
+        shape = (self.num_blocks + 1, s.num_layers, s.heads, P,
+                 s.head_dim)
+        self.cache_k = jnp.zeros(shape, dt)
+        self.cache_v = jnp.zeros(shape, dt)
+        telemetry.gauge("serve_kv_pool_bytes").set(
+            2 * int(np.prod(shape)) * np.dtype(dt).itemsize)
+        self.tables = np.full((self.max_slots, self.max_pages),
+                              self.scratch, np.int32)
+        self._owned: Dict[int, List[int]] = {}
+        self._shared_n: Dict[int, int] = {}
+        self._prefill_fns = {}
+        self._decode_fn = None
+
+    # --------------------------------------------------------- slot lifecycle
+    def pages_needed(self, prompt_len: int, max_new: int) -> int:
+        """Worst-case page budget of one request: every position the
+        sequence can ever write, rounded up to whole pages."""
+        return -(-(int(prompt_len) + int(max_new)) // self.page_tokens)
+
+    def try_admit(self, slot: int, tokens, max_new: int
+                  ) -> Optional[int]:
+        """Reserve the request's whole worst-case page budget on slot
+        ``slot``, reusing cached prefix pages by content hash.  Returns
+        the shared-prefix token count, or None (reserving nothing) when
+        the pool cannot cover the request right now — the caller keeps
+        it queued and retries after frees.  Reserving up front means
+        decode can never stall on allocation mid-flight."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        P = self.page_tokens
+        total = self.pages_needed(toks.size, max_new)
+        if total > self.max_pages:
+            raise MXNetError(
+                "request needs %d pages > max_pages %d"
+                % (total, self.max_pages))
+        # only FULL pages strictly before the last prompt token are
+        # shareable: prefill must still run >= 1 suffix token to emit
+        # the first-token (TTFT) logits
+        shared: List[int] = []
+        for d in prefix_hashes(toks, P)[:(toks.size - 1) // P]:
+            blk = self.pool.share(d)
+            if blk is None:
+                break
+            shared.append(blk)
+        fresh = self.pool.alloc(total - len(shared))
+        if fresh is None:
+            self.pool.release(shared)  # roll the reservation back
+            return None
+        row = self.tables[slot]
+        row[:] = self.scratch
+        blocks = shared + fresh
+        row[:total] = blocks
+        self._owned[slot] = blocks
+        self._shared_n[slot] = len(shared)
+        return len(shared) * P
+
+    def release_slot(self, slot: int) -> None:
+        """Return every page the slot owns (one refcount each: shared
+        prefix pages stay alive for their other sharers; private pages
+        free; hashed refcount-0 pages park in the prefix LRU) and reset
+        the slot's table row to scratch."""
+        blocks = self._owned.pop(slot, None)
+        self._shared_n.pop(slot, None)
+        self.tables[slot, :] = self.scratch
+        if blocks:
+            self.pool.release(blocks)
+
+    def register_prompt(self, slot: int, tokens) -> None:
+        """Content-address the slot's freshly prefilled FULL prompt
+        pages (past any shared prefix) so later prompts can skip them.
+        Call only after the prefill that filled them has been issued."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        digests = prefix_hashes(toks, self.page_tokens)
+        row = self.tables[slot]
+        for g in range(self._shared_n.get(slot, 0), len(digests)):
+            self.pool.register(int(row[g]), digests[g])
+
+    def shared_pages(self, slot: int) -> int:
+        return self._shared_n.get(slot, 0)
+
+    def owned_pages(self, slot: int) -> int:
+        return len(self._owned.get(slot, ()))
+
+    def ensure_writable(self, slot: int, position: int) -> None:
+        """Copy-on-write guard: make the page holding ``position``
+        privately owned before a write.  Decode writes land past the
+        shared prefix by construction, so this never copies on the hot
+        path — but if a shared page were ever the write target, it
+        diverges here instead of corrupting the cached prefix."""
+        page = int(position) // self.page_tokens
+        blk = int(self.tables[slot, page])
+        if blk == self.scratch:
+            return
+        new, copied = self.pool.make_private(blk)
+        if copied:
+            self.cache_k = self.cache_k.at[new].set(self.cache_k[blk])
+            self.cache_v = self.cache_v.at[new].set(self.cache_v[blk])
+        if new != blk:
+            self.tables[slot, page] = new
+            owned = self._owned[slot]
+            owned[owned.index(blk)] = new
+            if page < self._shared_n.get(slot, 0):
+                self._shared_n[slot] = page
+
+    # ------------------------------------------------------------ programs
+    def _build_prefill(self, L: int):
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+        s = model.spec
+        P = self.page_tokens
+        Lp = -(-L // P)
+        S = self.max_pages * P
+        scale = 1.0 / s.head_dim ** 0.5
+        neg = jnp.finfo(jnp.float32).min
+
+        def prefill(cache_k, cache_v, tokens, prefix_lens, suffix_lens,
+                    tables, write_tables):
+            # tokens (N, L): prompt SUFFIX past the shared prefix;
+            # prefix_lens/suffix_lens (N,); tables (N, max_pages);
+            # write_tables (N, Lp) — the fresh blocks the suffix pages
+            # scatter into (scratch for padding)
+            N = tokens.shape[0]
+            positions = prefix_lens[:, None] + jnp.arange(L)[None, :]
+            x = model._embed(tokens,
+                             jnp.minimum(positions, s.max_seq - 1))
+            causal = (jnp.arange(L)[:, None] >= jnp.arange(L)[None, :])
+            # cached-page mask: gathered position j is real prefix iff
+            # j < prefix_len (shared pages hold exactly prefix_len
+            # tokens; everything else in the gather is masked garbage)
+            pmask = (jnp.arange(S)[None, :]
+                     < prefix_lens[:, None])[:, None, None, :]
+            gk = cache_k[tables]  # (N, max_pages, layers, H, P, D)
+            gv = cache_v[tables]
+            gk = jnp.reshape(jnp.moveaxis(gk, 1, 3),
+                             (N, s.num_layers, s.heads, S, s.head_dim))
+            gv = jnp.reshape(jnp.moveaxis(gv, 1, 3),
+                             (N, s.num_layers, s.heads, S, s.head_dim))
+            ks, vs = [], []
+            for i in range(s.num_layers):
+                h = _ln(x, model.params["block%d_ln1_gamma" % i],
+                        model.params["block%d_ln1_beta" % i])
+                q, k, v = model._qkv(i, h)      # (N, L, H, D)
+                q = jnp.moveaxis(q, 1, 2)       # (N, H, L, D)
+                k = jnp.moveaxis(k, 1, 2)
+                v = jnp.moveaxis(v, 1, 2)
+                kc = gk[:, i].astype(jnp.float32)
+                vc = gv[:, i].astype(jnp.float32)
+                spre = jnp.einsum("nhqd,nhkd->nhqk", q, kc) * scale
+                spre = jnp.where(pmask, spre, neg)
+                sself = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
+                sself = jnp.where(causal, sself, neg)
+                # one softmax over [cached prefix | causal suffix]:
+                # masked lanes underflow to exactly 0, so a fresh
+                # prompt (prefix 0) matches the rectangular prefill
+                # bit-for-bit
+                w = jax.nn.softmax(
+                    jnp.concatenate([spre, sself], axis=-1), axis=-1)
+                att = jnp.einsum("nhqk,nhkd->nhqd", w[..., :S], vc) \
+                    + jnp.einsum("nhqk,nhkd->nhqd", w[..., S:], v)
+                att = jnp.moveaxis(att, 1, 2)   # (N, L, H, D)
+                x = model._attn_out(i, att, x)
+                x = model._ffn(i, x)
+                ks.append(k)
+                vs.append(v)
+            knew = jnp.stack(ks, axis=1)        # (N, layers, H, L, D)
+            vnew = jnp.stack(vs, axis=1)
+            pad = Lp * P - L
+            if pad:
+                cfg = ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))
+                knew = jnp.pad(knew, cfg)
+                vnew = jnp.pad(vnew, cfg)
+            # whole-page scatter: (N, Lp, layers, H, P, D) rows land on
+            # the write table's blocks.  Tail positions past the real
+            # suffix hold garbage but sit beyond `length`, so they are
+            # never attended — then decode overwrites them token by
+            # token (same contract as the rectangular padded rows).
+            knew = jnp.moveaxis(jnp.reshape(
+                knew, (N, s.num_layers, s.heads, Lp, P, s.head_dim)),
+                3, 1)
+            vnew = jnp.moveaxis(jnp.reshape(
+                vnew, (N, s.num_layers, s.heads, Lp, P, s.head_dim)),
+                3, 1)
+            cache_k = cache_k.at[write_tables].set(
+                knew.astype(cache_k.dtype))
+            cache_v = cache_v.at[write_tables].set(
+                vnew.astype(cache_v.dtype))
+            x = _ln(x, model.params["ln_f_gamma"],
+                    model.params["ln_f_beta"])
+            last = jnp.take_along_axis(
+                x, (suffix_lens - 1)[:, None, None], axis=1)[:, 0]
+            return cache_k, cache_v, model._head(last)
+
+        return prefill
+
+    def prefill(self, tokens: np.ndarray, prefix_lens: np.ndarray,
+                suffix_lens: np.ndarray, slots: np.ndarray):
+        """Run one padded suffix bucket through the paged prefill.
+        ``tokens`` (N, L) holds each request's suffix; ``slots`` (N,)
+        maps rows to slots, -1 for padding rows (scratch everywhere).
+        Mutates the cache in place; returns last-position logits."""
+        import jax
+        import jax.numpy as jnp
+
+        N, L = tokens.shape
+        P = self.page_tokens
+        Lp = -(-L // P)
+        fn = self._prefill_fns.get((N, L))
+        if fn is None:
+            fn = jax.jit(self._build_prefill(L))
+            self._prefill_fns[(N, L)] = fn
+        self.model.stats.record_batch(
+            ("paged_prefill", N, L),
+            int((np.asarray(slots) >= 0).sum()), N, "prefill")
+        tables = np.full((N, self.max_pages), self.scratch, np.int32)
+        write = np.full((N, Lp), self.scratch, np.int32)
+        for j in range(N):
+            if slots[j] < 0:
+                continue
+            row = self.tables[slots[j]]
+            tables[j] = row
+            start = int(prefix_lens[j]) // P
+            for p in range(Lp):
+                if start + p < self.max_pages:
+                    write[j, p] = row[start + p]
+        self.cache_k, self.cache_v, logits = fn(
+            self.cache_k, self.cache_v,
+            jnp.array(tokens, jnp.int32),
+            jnp.array(prefix_lens, jnp.int32),
+            jnp.array(suffix_lens, jnp.int32),
+            jnp.array(tables, jnp.int32),
+            jnp.array(write, jnp.int32))
+        return logits
+
+    def _build_decode(self):
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+        s = model.spec
+        P = self.page_tokens
+        S = self.max_pages * P
+        scale = 1.0 / s.head_dim ** 0.5
+        neg = jnp.finfo(jnp.float32).min
+
+        def decode(cache_k, cache_v, tokens, lengths, tables):
+            # tokens/lengths (slots,) int32; tables (slots, max_pages).
+            # The gather materializes the SAME rectangular view the
+            # PR 5 decode attends over — position j of the view is
+            # token position j of the sequence — so the attention math
+            # (and its reduction shapes) are identical and greedy
+            # tokens are bit-exact.
+            nslots = tokens.shape[0]
+            x = model._embed(tokens, lengths)
+            mask = (jnp.arange(S)[None, :]
+                    < lengths[:, None])[:, None, :]
+            gk = jnp.reshape(jnp.moveaxis(cache_k[tables], 1, 3),
+                             (nslots, s.num_layers, s.heads, S,
+                              s.head_dim))
+            gv = jnp.reshape(jnp.moveaxis(cache_v[tables], 1, 3),
+                             (nslots, s.num_layers, s.heads, S,
+                              s.head_dim))
+            ks, vs = [], []
+            for i in range(s.num_layers):
+                h = _ln(x, model.params["block%d_ln1_gamma" % i],
+                        model.params["block%d_ln1_beta" % i])
+                q, k, v = model._qkv(i, h)      # (slots, H, D)
+                kc = gk[:, i].astype(jnp.float32)
+                vc = gv[:, i].astype(jnp.float32)
+                sc = jnp.einsum("nhd,nhkd->nhk", q, kc) * scale
+                sc = jnp.where(mask, sc, neg)
+                s_self = jnp.einsum("nhd,nhd->nh", q, k) * scale
+                full = jnp.concatenate([sc, s_self[..., None]],
+                                       axis=-1)
+                w = jax.nn.softmax(full, axis=-1)
+                att = jnp.einsum("nhk,nhkd->nhd", w[..., :S], vc) \
+                    + w[..., S, None] * v
+                x = model._attn_out(i, att, x)
+                x = model._ffn(i, x)
+                ks.append(k)
+                vs.append(v)
+            knew = jnp.stack(ks, axis=1)    # (slots, layers, H, D)
+            vnew = jnp.stack(vs, axis=1)
+            pos = jnp.minimum(lengths, S - 1)
+            blk = jnp.take_along_axis(tables, (pos // P)[:, None],
+                                      axis=1)[:, 0]
+            off = pos % P
+            cache_k = cache_k.at[blk, :, :, off, :].set(
+                knew.astype(cache_k.dtype))
+            cache_v = cache_v.at[blk, :, :, off, :].set(
+                vnew.astype(cache_v.dtype))
+            x = _ln(x, model.params["ln_f_gamma"],
+                    model.params["ln_f_beta"])
+            return cache_k, cache_v, model._head(x)
+
+        return decode
+
+    def decode(self, tokens: np.ndarray, lengths: np.ndarray):
+        """One single-token step over the full slot batch — the ONE
+        compiled paged-decode program.  Mutates the cache in place;
+        returns (slots, vocab) logits."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._decode_fn is None:
+            self._decode_fn = jax.jit(self._build_decode())
+        n = int(np.asarray(tokens).shape[0])
+        self.model.stats.record_batch(("paged_decode", n), n, n,
+                                      "decode")
+        self.cache_k, self.cache_v, logits = self._decode_fn(
+            self.cache_k, self.cache_v,
+            jnp.array(tokens, jnp.int32),
+            jnp.array(lengths, jnp.int32),
+            jnp.array(self.tables, jnp.int32))
+        return logits
+
+
+class PagedGenerationEngine(GenerationEngine):
+    """:class:`GenerationEngine` over a :class:`PagedKVCache`: same
+    continuous-batching loop, but admission reserves KV PAGES (worst
+    case per request) instead of a max-length rectangle, prompts
+    sharing a cached prefix prefill only their suffix, and finished or
+    expired sequences return their pages to the pool.
+
+    Extra knobs: ``page_tokens`` (``TP_SERVE_PAGE_TOKENS``, default 16)
+    and ``pool_blocks`` (``TP_SERVE_KV_POOL_BLOCKS``, default
+    ``max_slots * ceil(max_len / page_tokens)`` — the same HBM as the
+    rectangle, which the pool then shares by actual need)."""
+
+    def __init__(self, model: KVTransformerLM, *,
+                 page_tokens: Optional[int] = None,
+                 pool_blocks: Optional[int] = None, **kw):
+        self._ctor_page_tokens = page_tokens
+        self._ctor_pool_blocks = pool_blocks
+        kw.setdefault("name", "serve_paged_lm")
+        super().__init__(model, **kw)
+
+    def _setup_cache(self) -> None:
+        self._kv = PagedKVCache(
+            self.model, self.max_slots, self.max_len,
+            page_tokens=self._ctor_page_tokens,
+            num_blocks=self._ctor_pool_blocks)
+        # the paged cache owns the device arrays; the rectangular
+        # attrs stay unused
+        self._cache_k = self._cache_v = None
+
+    @property
+    def pool(self) -> BlockPool:
+        return self._kv.pool
+
+    @property
+    def kv(self) -> PagedKVCache:
+        return self._kv
+
+    # ---------------------------------------------------------- admission
+    def _check_request(self, tokens: np.ndarray, max_new: int) -> None:
+        super()._check_request(tokens, max_new)
+        need = self._kv.pages_needed(tokens.size, max_new)
+        if need > self._kv.num_blocks:
+            raise MXNetError(
+                "request needs %d KV pages but the pool holds only %d "
+                "(TP_SERVE_KV_POOL_BLOCKS)"
+                % (need, self._kv.num_blocks))
+
+    def _take_admissible(self) -> List[_GenPending]:
+        """Admit by free-PAGE count: reserve each request's worst-case
+        page budget (and a slot) up front; the first request that does
+        not fit blocks the queue (FIFO — no starvation) until frees
+        make room.  Must hold the lock."""
+        free = [i for i, s in enumerate(self._seqs) if s is None]
+        take: List[_GenPending] = []
+        rest: List[_GenPending] = []
+        for p in self._pending:
+            if rest or not free:
+                rest.append(p)
+                continue
+            shared = self._kv.try_admit(free[0], p.tokens, p.max_new)
+            if shared is None:
+                rest.append(p)
+                continue
+            p.slot = free.pop(0)
+            p.shared_tokens = shared
+            take.append(p)
+        self._pending = rest
+        telemetry.gauge("serve_queue_depth").set(len(self._pending))
+        return take
+
+    def _admit(self, reqs: List[_GenPending]) -> None:
+        """Prefill each newcomer's SUFFIX past its shared prefix,
+        bucketed by suffix length; register the fresh full prompt
+        pages for future prefix hits; sample the first token (TTFT).
+        A request whose deadline expired between reservation and here
+        releases its pages BEFORE its future fails."""
+        now = time.monotonic()
+        live: List[_GenPending] = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                self._kv.release_slot(r.slot)
+                self.stats.expired += 1
+                telemetry.counter("serve_deadline_expired_total").inc()
+                r.future.set_exception(MXNetError(
+                    "request deadline expired after %.1f ms in queue"
+                    % ((now - r.t_submit) * 1e3)))
+            else:
+                live.append(r)
+        groups: Dict[int, List[_GenPending]] = {}
+        for r in live:
+            L = bucket_length(r.tokens.size - r.shared_tokens,
+                              self.max_len)
+            groups.setdefault(L, []).append(r)
+        for L, group in sorted(groups.items()):
+            for start in range(0, len(group), self.max_slots):
+                chunk = group[start:start + self.max_slots]
+                n = len(chunk)
+                nb = bucket_batch(n, self.max_slots)
+                toks = np.zeros((nb, L), np.int32)
+                plens = np.zeros(nb, np.int32)
+                slens = np.ones(nb, np.int32)
+                slots = np.full(nb, -1, np.int32)
+                for j, r in enumerate(chunk):
+                    suffix = r.tokens[r.shared_tokens:]
+                    toks[j, :suffix.size] = suffix
+                    plens[j] = r.shared_tokens
+                    slens[j] = suffix.size
+                    slots[j] = r.slot
+                    self.prefill_tokens += int(suffix.size)
+                telemetry.counter("serve_prefill_tokens_total").inc(
+                    int(sum(r.tokens.size - r.shared_tokens
+                            for r in chunk)))
+                logits = np.asarray(
+                    self._kv.prefill(toks, plens, slens, slots))
+                now = time.monotonic()
+                for j, r in enumerate(chunk):
+                    seq = _Seq(r, r.slot, r.tokens.size)
+                    self._seqs[r.slot] = seq
+                    self._lengths[r.slot] = r.tokens.size
+                    # register before _emit: a 1-token request finishes
+                    # inside _emit and releases the slot immediately —
+                    # its prompt pages must already be content-
+                    # addressed so they park in the LRU, not the free
+                    # list
+                    self._kv.register_prompt(r.slot, r.tokens)
+                    self._emit(seq, logits[j], now)
+
+    # ------------------------------------------------------------- decode
+    def _decode_batch(self, tokens: np.ndarray) -> np.ndarray:
+        P = self._kv.page_tokens
+        for i, seq in enumerate(self._seqs):
+            # CoW guard: only consult the pool when the write position
+            # could touch a shared page (never true by construction —
+            # shared pages end before the first decode write — but a
+            # page copy beats silent prefix corruption)
+            if seq is not None \
+                    and seq.length // P < self._kv.shared_pages(i):
+                self._kv.ensure_writable(i, seq.length)
+        return self._kv.decode(tokens, self._lengths)
+
+    # ------------------------------------------------------------ teardown
+    def _release(self, slot: int) -> None:
+        self._kv.release_slot(slot)
+        super()._release(slot)

@@ -33,8 +33,12 @@ site/role, ``quant_amax_rescales_total`` — docs/quantization.md),
 ``ckpt_bytes``, ``ckpt_async_queue_depth``, ``restores_total``,
 ``ckpt_restore_seconds``, ``ckpt_restore_failures_total``,
 ``ckpt_gc_total``, ``preemptions_total``, ``faults_injected_total``
-— docs/fault_tolerance.md), and device memory via
-``jax.local_devices()[*].memory_stats()``.
+— docs/fault_tolerance.md), ``serving.paged`` (``serve_kv_pages_free``
+/ ``_used`` / ``_cached`` + ``serve_kv_pool_bytes`` gauges,
+``serve_prefix_hits_total``, ``serve_prefix_hit_tokens_total``,
+``serve_prefix_evictions_total``, ``serve_kv_cow_total``,
+``serve_prefill_tokens_total`` — docs/paged_kv.md), and device memory
+via ``jax.local_devices()[*].memory_stats()``.
 
 Env controls::
 

@@ -248,6 +248,28 @@ def test_generation_sampling_policies(model):
         np.testing.assert_array_equal(res.tokens, res2.tokens)
 
 
+def test_recycled_slot_cannot_attend_stale_kv(model):
+    """Regression: a SHORT prompt recycled into a slot that previously
+    held a LONG sequence must not attend the previous occupant's K/V.
+    The release path zeroes the slot's mask length, so positions past
+    the new prompt are unreachable even though stale bytes remain in
+    the cache — the greedy chain must equal the fresh-cache oracle."""
+    rng = np.random.RandomState(11)
+    with GenerationEngine(model, max_slots=1, max_len=S) as eng:
+        long_p = rng.randint(0, V, size=12).astype(np.int32)
+        eng.generate(long_p, max_new_tokens=6)  # slot 0 now "dirty"
+        assert eng._lengths[0] == 0  # explicit invalidation on free
+        short_p = rng.randint(0, V, size=2).astype(np.int32)
+        res = eng.generate(short_p, max_new_tokens=4,
+                           return_logits=True)
+    seq = np.concatenate([short_p, res.tokens.astype(np.int32)])
+    full = model.full_logits(seq)
+    for i, (t, lg) in enumerate(zip(res.tokens, res.logits)):
+        np.testing.assert_allclose(lg, full[0, 1 + i], atol=1e-5,
+                                   rtol=0)
+        assert int(t) == int(np.argmax(full[0, 1 + i]))
+
+
 def test_generation_stop_token(model):
     """stop_token ends the sequence early and frees the slot."""
     prompt = np.array([1, 2], np.int32)

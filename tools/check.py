@@ -20,16 +20,20 @@ Checks, in order:
    ``tests/test_pipeline_schedule.py``; needs jax — skip with
    ``TP_CHECK_SCHEDULE=0``);
 5. **serving** — the serving smoke subset (``TP_CHECK_SERVE=0`` skips);
-6. **overlap** — the overlapped-train-loop bit-equality subset
+6. **paged** — the paged-KV subset: paged-vs-rectangular greedy
+   parity through slot recycling, the prefix-cache hit proof, the
+   equal-HBM capacity win and the one-compiled-decode bound
+   (``tests/test_paged_kv.py``; ``TP_CHECK_PAGED=0`` skips);
+7. **overlap** — the overlapped-train-loop bit-equality subset
    (``tests/test_overlap.py``; ``TP_CHECK_OVERLAP=0`` skips);
-7. **quant** — the quantized-path subset: int8 serving parity, the
+8. **quant** — the quantized-path subset: int8 serving parity, the
    fp8 shift-task A/B gate and the default-path bit-exactness
    (``tests/test_quant.py``; ``TP_CHECK_QUANT=0`` skips);
-8. **resilience** — the fault-tolerance subset: the crash-and-resume
+9. **resilience** — the fault-tolerance subset: the crash-and-resume
    A/B bit-equality, torn-save fallback, preemption final save and
    injector determinism (``tests/test_resilience.py``;
    ``TP_CHECK_FAULT=0`` skips);
-9. **static-analysis** — the ``tools/lint.py`` suite (graph verifier
+10. **static-analysis** — the ``tools/lint.py`` suite (graph verifier
    over the model zoo, tracing-hazard lint, lock-order checker,
    env-knob drift; docs/static_analysis.md): zero unsuppressed
    findings (needs jax — skip with ``TP_CHECK_LINT=0``).
@@ -194,6 +198,41 @@ def check_serving(problems):
                         + "\n  ".join(tail))
 
 
+def check_paged(problems):
+    """Paged-KV gate (docs/paged_kv.md): paged greedy tokens bit-equal
+    to the rectangular engine's through slot recycling, a prompt
+    sharing a cached prefix provably skips prefill for the shared
+    blocks, the pool admits strictly more concurrent mixed-length
+    sequences than the rectangle at equal HBM, and decode stays ONE
+    compiled program.  The heavy tests carry ``@pytest.mark.slow`` so
+    the tier-1 sweep skips them; this gate runs them by id, so they
+    stay CI-enforced (needs jax — skip with ``TP_CHECK_PAGED=0``)."""
+    if os.environ.get("TP_CHECK_PAGED", "1") == "0":
+        return
+    import subprocess
+
+    tests = "tests/test_paged_kv.py"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q",
+             "-p", "no:cacheprovider", "-p", "no:randomly",
+             tests
+             + "::test_paged_engine_bitexact_vs_rectangular_with_recycle",
+             tests + "::test_prefix_hit_skips_prefill_for_shared_blocks",
+             tests + "::test_paged_admits_more_than_rectangle_at_equal_hbm",
+             tests + "::test_paged_compile_bound_under_mixed_load"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        problems.append("paged: smoke run did not finish: %s" % e)
+        return
+    if proc.returncode != 0:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-12:]
+        problems.append("paged: paged-KV gate failed:\n  "
+                        + "\n  ".join(tail))
+
+
 def check_overlap(problems):
     """Overlap-equality gate (docs/input_pipeline.md): the bounded
     dispatch window, device staging, and on-device metrics must leave
@@ -328,6 +367,7 @@ def main():
     check_docs(problems)
     check_schedule(problems)
     check_serving(problems)
+    check_paged(problems)
     check_overlap(problems)
     check_quant(problems)
     check_resilience(problems)

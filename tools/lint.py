@@ -35,6 +35,7 @@ PASSES = ("graph", "tracing", "locks", "env")
 LOCK_MODULES = [
     "incubator_mxnet_tpu/serving/engine.py",
     "incubator_mxnet_tpu/serving/generate.py",
+    "incubator_mxnet_tpu/serving/paged.py",
     "incubator_mxnet_tpu/io.py",
     "incubator_mxnet_tpu/resilience/manager.py",
     "incubator_mxnet_tpu/resilience/faults.py",
