@@ -11,7 +11,7 @@ _REPO = os.path.dirname(os.path.dirname(
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
-if os.environ.get("TP_EXAMPLES_FORCE_CPU") == "1":
+if os.environ.get("TP_EXAMPLES_FORCE_CPU", "0") == "1":
     # the axon TPU plugin ignores JAX_PLATFORMS=cpu; tests force the CPU
     # backend via the config API before jax initializes (tests/conftest.py)
     _n = int(os.environ.get("TP_EXAMPLES_CPU_DEVICES", "0"))

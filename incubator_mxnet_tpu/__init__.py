@@ -68,6 +68,14 @@ if base.get_env("LOCK_CHECK", False, bool):
 
     install_runtime_checker()
     del install_runtime_checker
+# TP_RACE_CHECK=1 arms the Eraser-mode lockset tracker over the
+# @race_audit classes (implies the lock checker: it reads the
+# per-thread held stacks)
+if base.get_env("RACE_CHECK", False, bool):
+    from .analysis.race_checker import install_race_checker
+
+    install_race_checker()
+    del install_race_checker
 from .context import Context, cpu, tpu, gpu, cpu_pinned, current_context, \
     num_tpus, num_gpus
 from . import engine

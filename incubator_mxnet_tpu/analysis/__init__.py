@@ -13,7 +13,13 @@ Three pass families (ISSUE 8):
   that wraps ``threading.Lock`` to assert one global order and flag
   held-lock blocking calls.
 - :mod:`.env_drift` — every ``TP_*`` knob the code reads must appear in
-  ``docs/env_var.md`` and vice versa.
+  ``docs/env_var.md`` and vice versa, with matching documented
+  defaults.
+- :mod:`.race_checker` — Eraser-style lockset data-race detection over
+  the threaded classes: static thread-role x lockset analysis plus an
+  opt-in runtime mode (``TP_RACE_CHECK=1``) that instruments audited
+  classes' attribute access and raises when a shared attribute's
+  candidate lockset empties after multi-thread writes.
 
 All passes report :class:`~.findings.Finding` records with file:line or
 graph-node provenance, honoring ``# tp-lint: disable=<rule> -- why``
@@ -39,6 +45,12 @@ _EXPORTS = {
     "runtime_checker_active": ("lock_checker",
                                "runtime_checker_active"),
     "check_env_drift": ("env_drift", "check_env_drift"),
+    "analyze_race_files": ("race_checker", "analyze_race_files"),
+    "race_audit": ("race_checker", "race_audit"),
+    "install_race_checker": ("race_checker", "install_race_checker"),
+    "uninstall_race_checker": ("race_checker",
+                               "uninstall_race_checker"),
+    "race_checker_active": ("race_checker", "race_checker_active"),
 }
 
 __all__ = sorted(_EXPORTS)

@@ -6,8 +6,13 @@ maps ``get_env("X")`` to ``TP_X``/``MXNET_X``, or via direct
 *exact* knob the doc lists must actually be read somewhere.  Glob rows
 like ``TP_BENCH_*`` document a family and satisfy any matching read.
 
-Rules: ``env-undocumented`` (read but absent from the doc) and
-``env-unread`` (documented but never read — stale doc).
+Rules: ``env-undocumented`` (read but absent from the doc),
+``env-unread`` (documented but never read — stale doc), and
+``env-default-drift`` (the doc's Default column disagrees with the
+literal fallback at the read site).  Default comparison is best-effort:
+only literal code defaults and simple doc cells (numbers, words,
+``—`` for "no default") are compared; descriptive cells like
+``2^19`` or derived formulas are skipped.
 """
 from __future__ import annotations
 
@@ -20,7 +25,8 @@ from typing import Dict, List, Set, Tuple
 from .findings import Finding
 
 __all__ = ["check_env_drift", "collect_env_reads",
-           "collect_documented"]
+           "collect_documented", "collect_documented_defaults",
+           "collect_read_defaults"]
 
 _DOC_TOKEN = re.compile(r"\b(TP_[A-Z0-9_]+(?:_\*|\*)?)")
 _SKIP_DIRS = {"tests", ".git", "__pycache__", ".claude"}
@@ -39,6 +45,38 @@ def collect_documented(doc_path: str) -> Tuple[Dict[str, int], Set[str]]:
             else:
                 exact.setdefault(tok, lineno)
     return exact, globs
+
+
+def collect_documented_defaults(doc_path: str) -> Dict[str, Tuple[str,
+                                                                  int]]:
+    """Exact knob name -> (Default-column cell, doc line).
+
+    Parses the markdown tables: a row's first cell names the knob(s),
+    its second cell is the documented default.  Rows naming several
+    knobs (``TP_A / TP_B``) zip against a slash-separated default cell
+    when the counts line up, else every name gets the whole cell.
+    """
+    with open(doc_path, "r") as f:
+        lines = f.read().splitlines()
+    out: Dict[str, Tuple[str, int]] = {}
+    for lineno, line in enumerate(lines, start=1):
+        if not line.lstrip().startswith("|"):
+            continue
+        cells = [c.strip().strip("`").strip()
+                 for c in line.strip().strip("|").split("|")]
+        if len(cells) < 2:
+            continue
+        names = [t for t in _DOC_TOKEN.findall(cells[0])
+                 if not t.endswith("*")]
+        if not names:
+            continue
+        defaults = [d.strip().strip("`").strip()
+                    for d in cells[1].split("/")]
+        if len(defaults) != len(names):
+            defaults = [cells[1]] * len(names)
+        for name, d in zip(names, defaults):
+            out.setdefault(name, (d, lineno))
+    return out
 
 
 def _py_files(root: str) -> List[str]:
@@ -102,6 +140,97 @@ def collect_env_reads(repo_root: str) -> Dict[str, Tuple[str, int]]:
     return reads
 
 
+_NON_LITERAL = object()  # default exists but is not a literal constant
+
+
+def collect_read_defaults(repo_root: str,
+                          ) -> Dict[str, Tuple[str, int, object]]:
+    """TP_* name -> (file, line, fallback) at one ``get_env`` /
+    ``os.environ.get`` read site.
+
+    The fallback is the literal constant passed as the default
+    (``None`` when omitted), or ``_NON_LITERAL`` when it is a computed
+    expression — those sites are skipped by the drift comparison.
+    """
+    roots = [os.path.join(repo_root, "incubator_mxnet_tpu"),
+             os.path.join(repo_root, "tools"),
+             os.path.join(repo_root, "examples")]
+    files: List[str] = []
+    for r in roots:
+        if os.path.isdir(r):
+            files.extend(_py_files(r))
+    for f in os.listdir(repo_root):
+        if f.endswith(".py"):
+            files.append(os.path.join(repo_root, f))
+
+    out: Dict[str, Tuple[str, int, object]] = {}
+
+    def fallback(call, pos):
+        node = None
+        if len(call.args) > pos:
+            node = call.args[pos]
+        else:
+            for kw in call.keywords:
+                if kw.arg == "default":
+                    node = kw.value
+        if node is None:
+            return None
+        if isinstance(node, ast.Constant):
+            return node.value
+        return _NON_LITERAL
+
+    for path in files:
+        with open(path, "r") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _dotted(node.func)
+            arg = node.args[0] if node.args else None
+            name = arg.value if isinstance(arg, ast.Constant) \
+                and isinstance(arg.value, str) else None
+            if name is None:
+                continue
+            if fn is not None and fn.endswith("get_env"):
+                out.setdefault("TP_" + name,
+                               (rel, node.lineno, fallback(node, 1)))
+            elif fn in ("os.getenv", "os.environ.get", "environ.get") \
+                    and name.startswith("TP_"):
+                out.setdefault(name,
+                               (rel, node.lineno, fallback(node, 1)))
+    return out
+
+
+_SIMPLE_CELL = re.compile(r"-?[A-Za-z0-9_.+\-]+$")
+_NO_DEFAULT_CELLS = ("", "—", "-", "–", "none", "None", "unset",
+                     "required")
+
+
+def _defaults_match(doc_cell: str, code_default: object):
+    """True/False when comparable, ``None`` when the doc cell is
+    descriptive (a formula, a range) and no comparison is possible."""
+    cell = doc_cell.strip().strip("`").strip()
+    if cell in _NO_DEFAULT_CELLS:
+        # an empty-string fallback is "no value" too
+        return code_default is None or code_default == ""
+    if not _SIMPLE_CELL.fullmatch(cell):
+        return None  # descriptive cell — not comparable
+    if code_default is None:
+        return False
+    if isinstance(code_default, bool):
+        return cell == ("1" if code_default else "0") \
+            or cell.lower() == str(code_default).lower()
+    try:
+        return float(cell) == float(code_default)
+    except (TypeError, ValueError):
+        return cell == str(code_default)
+
+
 def _dotted(node):
     parts = []
     while isinstance(node, ast.Attribute):
@@ -141,4 +270,22 @@ def check_env_drift(repo_root: str,
                 message="'%s' is documented in %s but nothing reads "
                         "it — stale doc or dead knob" % (name, doc_rel),
                 file=doc_rel, line=doc_line, severity="warning"))
+
+    doc_defaults = collect_documented_defaults(doc_path)
+    code_defaults = collect_read_defaults(repo_root)
+    for name, (cell, doc_line) in sorted(doc_defaults.items()):
+        site = code_defaults.get(name)
+        if site is None:
+            continue  # env-unread already covers doc-only knobs
+        file, line, fb = site
+        if fb is _NON_LITERAL:
+            continue  # computed fallback — nothing to compare
+        ok = _defaults_match(cell, fb)
+        if ok is False:
+            findings.append(Finding(
+                rule="env-default-drift",
+                message="'%s' falls back to %r here but %s:%d "
+                        "documents the default as '%s'"
+                        % (name, fb, doc_rel, doc_line, cell),
+                file=file, line=line, ident=name))
     return findings

@@ -33,6 +33,9 @@ class Finding:
     line: Optional[int] = None
     node: Optional[str] = None
     severity: str = "error"  # "error" | "warning"
+    # stable identity of the subject (lock/attr/knob name) — combined
+    # with rule + file it keys SARIF fingerprints across line churn
+    ident: Optional[str] = None
 
     def location(self) -> str:
         if self.file is not None:

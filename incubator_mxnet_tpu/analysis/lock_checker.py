@@ -471,6 +471,11 @@ class _CheckedLock:
         return self._lock.locked() if hasattr(self._lock, "locked") \
             else False
 
+    def _at_fork_reinit(self):
+        # os.register_at_fork hook (bpo-39812): concurrent.futures
+        # re-initializes its module locks in the forked child
+        self._lock._at_fork_reinit()
+
     # Condition(_CheckedLock) support: python's Condition delegates to
     # these when present
     def _is_owned(self):

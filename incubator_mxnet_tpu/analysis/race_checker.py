@@ -1,0 +1,781 @@
+"""Lockset data-race detector: static thread-role x lockset analysis
+plus an opt-in runtime Eraser mode (``TP_RACE_CHECK=1``).
+
+Static pass
+-----------
+For each class that owns background threads (``threading.Thread(
+target=self._x)``, executor ``submit(self._x)``, timers), every entry
+point is classified by *thread role*:
+
+- ``thread:<m>``  the background-thread target methods
+- ``api``         public methods + container dunders — the caller side
+- ``final``       ``__del__`` / ``atexit`` / ``signal`` contexts
+- ``init``        ``__init__`` (exempt until the first ``.start()``)
+
+Each entry point's body is walked (following ``self.method()`` /
+``self.attr.method()`` one level, the same resolution depth as
+``lock_checker``) collecting every ``self.<attr>`` read/write together
+with the lockset held at that site — lock identity is the
+``Class.attr`` scheme shared with :mod:`.lock_checker`, including
+member-object locks (``with self.stats.lock:`` resolves through the
+``self.stats = ServeStats()`` attribute type).  Accesses through a
+member of a known class unify on the *member's* identity
+(``ServeStats.requests``), so ``self.stats.requests += 1`` in the
+engine joins with ``self.requests`` accesses inside ``ServeStats``.
+
+Rules:
+
+- ``race-unlocked-shared-state``  an attribute reachable from >= 2
+  thread roles with >= 1 write whose access locksets have an empty
+  intersection — the Eraser lockset condition.  A *public* attribute
+  written on a background thread with no lock held is also reported
+  (external readers are an implicit unlocked role).
+- ``race-check-then-act``   an ``if`` guard reads an attribute and a
+  dependent write in the branch runs under a different (or no) lock —
+  the state can change between test and act.
+- ``race-init-escape``      ``__init__`` assigns an attribute *after*
+  starting the background thread that reads it; the thread can observe
+  the missing/partial value.  Assignments before the first
+  ``.start()`` are exempt (single-threaded construction).
+
+Thread-safe primitives (``Event``/``Queue``/``deque``/locks/
+``Thread``) are exempt from mutation tracking: only *rebinding* such
+an attribute counts as a write.
+
+Runtime pass
+------------
+:func:`install_race_checker` extends the ``TP_LOCK_CHECK`` threading
+patches' per-thread held-lock stacks with Eraser lockset refinement.
+Classes marked with the :func:`race_audit` decorator get their
+attribute access instrumented (``__getattribute__``/``__setattr__``):
+each shared attribute starts *exclusive* to its first thread; from the
+second thread on, its candidate lockset is intersected with the locks
+held at every access, and the checker raises ``MXNetError`` carrying
+both threads' stacks the moment the set empties after a shared-state
+write.  The decorator is free when the checker is off — it only
+registers the class.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+import threading
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..base import MXNetError
+from .findings import Finding
+from .lock_checker import _ClassInfo, _dotted, _scan_classes
+from . import lock_checker as _lc
+
+__all__ = ["analyze_race_files", "race_audit", "install_race_checker",
+           "uninstall_race_checker", "race_checker_active"]
+
+# types whose *internal* mutation is thread-safe: only rebinding the
+# attribute races.  deque append/popleft are GIL-atomic by contract.
+_THREADSAFE_TYPES = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier", "local", "Thread", "Timer",
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue", "deque",
+    "ThreadPoolExecutor",
+}
+
+# method calls that mutate a plain container receiver
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "clear", "update", "add", "discard",
+    "setdefault", "sort", "reverse", "fill",
+}
+
+_API_DUNDERS = {"__iter__", "__next__", "__enter__", "__exit__",
+                "__call__", "__len__", "__contains__", "__getitem__",
+                "__setitem__"}
+
+
+def _self_method(node: ast.AST) -> Optional[str]:
+    d = _dotted(node)
+    if d and d.startswith("self.") and d.count(".") == 1:
+        return d[len("self."):]
+    return None
+
+
+def _scan_threadsafe(tree: ast.Module) -> Dict[str, Set[str]]:
+    """Class name -> attrs assigned a thread-safe primitive type."""
+    out: Dict[str, Set[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        attrs: Set[str] = set()
+        out[node.name] = attrs
+        for item in ast.walk(node):
+            if not (isinstance(item, ast.Assign)
+                    and isinstance(item.value, ast.Call)):
+                continue
+            ctor = _dotted(item.value.func) or ""
+            if ctor.rsplit(".", 1)[-1] not in _THREADSAFE_TYPES:
+                continue
+            for tgt in item.targets:
+                d = _dotted(tgt)
+                if d and d.startswith("self.") and d.count(".") == 1:
+                    attrs.add(d[len("self."):])
+    return out
+
+
+def _thread_roles(cls: _ClassInfo) -> Dict[str, Set[str]]:
+    """Method name -> roles discovered from thread/finalizer wiring."""
+    roles: Dict[str, Set[str]] = {}
+
+    def mark(m: str, role: str):
+        roles.setdefault(m, set()).add(role)
+
+    for meth in cls.methods.values():
+        for call in ast.walk(meth):
+            if not isinstance(call, ast.Call):
+                continue
+            fn = _dotted(call.func) or ""
+            tail = fn.rsplit(".", 1)[-1]
+            cands: List[ast.AST] = []
+            if tail in ("Thread", "Timer"):
+                for kw in call.keywords:
+                    if kw.arg in ("target", "function"):
+                        cands.append(kw.value)
+                if tail == "Timer" and len(call.args) >= 2:
+                    cands.append(call.args[1])
+            elif tail in ("submit", "apply_async", "run_in_executor",
+                          "start_new_thread"):
+                if call.args:
+                    cands.append(call.args[0])
+            elif fn in ("atexit.register", "weakref.finalize"):
+                for a in list(call.args) \
+                        + [kw.value for kw in call.keywords]:
+                    m = _self_method(a)
+                    if m:
+                        mark(m, "final")
+                continue
+            elif fn == "signal.signal" and len(call.args) >= 2:
+                m = _self_method(call.args[1])
+                if m:
+                    mark(m, "final")
+                continue
+            for cand in cands:
+                m = _self_method(cand)
+                if m:
+                    mark(m, "thread:" + m)
+                elif isinstance(cand, ast.Lambda):
+                    for sub in ast.walk(cand.body):
+                        if isinstance(sub, ast.Call):
+                            sm = _self_method(sub.func)
+                            if sm:
+                                mark(sm, "thread:" + sm)
+    if "__del__" in cls.methods:
+        mark("__del__", "final")
+    return roles
+
+
+class _Access:
+    __slots__ = ("attr", "write", "locks", "line", "role", "method")
+
+    def __init__(self, attr, write, locks, line, role, method):
+        self.attr = attr
+        self.write = write
+        self.locks = locks
+        self.line = line
+        self.role = role
+        self.method = method
+
+
+class _RaceWalker:
+    """Collect self-attribute accesses + held locksets for one entry."""
+
+    def __init__(self, path: str, classes: Dict[str, _ClassInfo],
+                 ts: Dict[str, Set[str]], cls: _ClassInfo, role: str,
+                 method: str, accesses: List[_Access],
+                 cta: List[Tuple[_Access, _Access]], depth: int = 0):
+        self.path = path
+        self.classes = classes
+        self.ts = ts
+        self.cls = cls
+        self.role = role
+        self.method = method
+        self.accesses = accesses
+        self.cta = cta
+        self.depth = depth
+
+    # -------------------------------------------------- lock identity
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        d = _dotted(expr)
+        if d is None or not d.startswith("self."):
+            return None
+        parts = d.split(".")[1:]
+        if len(parts) == 1 and parts[0] in self.cls.locks:
+            return "%s.%s" % (self.cls.name, parts[0])
+        if len(parts) == 2:
+            t = self.cls.attr_types.get(parts[0])
+            tc = self.classes.get(t) if t else None
+            if tc is not None and parts[1] in tc.locks:
+                return "%s.%s" % (tc.name, parts[1])
+            # member of unknown type (e.g. aliased from another object):
+            # a with-statement over a lock-named attribute is still a
+            # lock acquisition with a stable per-class identity
+            if parts[1] in ("lock", "_lock", "mutex", "_mutex",
+                            "cond", "_cond"):
+                return "%s.%s.%s" % (self.cls.name, parts[0], parts[1])
+        return None
+
+    def _self_path(self, node: ast.AST) -> Optional[Tuple[str, ...]]:
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name) and node.id == "self" and parts:
+            parts.reverse()
+            return tuple(parts[:2])
+        return None
+
+    # ------------------------------------------------------ recording
+    def _record(self, path: Tuple[str, ...], write: bool,
+                held: Tuple[str, ...], line: int):
+        cls = self.cls
+        a0 = path[0]
+        if a0 in cls.locks or a0 in cls.methods:
+            return
+        if len(path) == 1:
+            ident = "%s.%s" % (cls.name, a0)
+        else:
+            a1 = path[1]
+            t = cls.attr_types.get(a0)
+            tc = self.classes.get(t) if t else None
+            if tc is not None:
+                if a1 in tc.locks or a1 in tc.methods:
+                    return
+                ident = "%s.%s" % (tc.name, a1)
+            else:
+                ident = "%s.%s.%s" % (cls.name, a0, a1)
+        self.accesses.append(_Access(ident, write, frozenset(held),
+                                     line, self.role, self.method))
+
+    # ----------------------------------------------------- statements
+    def walk_body(self, body, held: Tuple[str, ...]):
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt, held: Tuple[str, ...]):
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in stmt.items:
+                lid = self._lock_id(item.context_expr)
+                if lid is not None:
+                    inner = inner + (lid,)
+                else:
+                    self._expr(item.context_expr, inner)
+                if item.optional_vars is not None:
+                    self._write_target(item.optional_vars, inner)
+            self.walk_body(stmt.body, inner)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value, held)
+            for t in stmt.targets:
+                self._write_target(t, held)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value, held)
+            self._write_target(stmt.target, held)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value, held)
+            self._write_target(stmt.target, held)
+            return
+        if isinstance(stmt, ast.If):
+            n_test = len(self.accesses)
+            self._expr(stmt.test, held)
+            test_reads = [a for a in self.accesses[n_test:]
+                          if not a.write]
+            n_body = len(self.accesses)
+            self.walk_body(stmt.body, held)
+            body_accs = self.accesses[n_body:]
+            for tr in test_reads:
+                for w in body_accs:
+                    if w.write and w.attr == tr.attr:
+                        self.cta.append((tr, w))
+                        break
+            self.walk_body(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test, held)
+            self.walk_body(stmt.body, held)
+            self.walk_body(stmt.orelse, held)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter, held)
+            self._write_target(stmt.target, held)
+            self.walk_body(stmt.body, held)
+            self.walk_body(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk_body(stmt.body, held)
+            for h in stmt.handlers:
+                self.walk_body(h.body, held)
+            self.walk_body(stmt.orelse, held)
+            self.walk_body(stmt.finalbody, held)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: body runs later, not under the current locks
+            self.walk_body(stmt.body, ())
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._write_target(t, held)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+
+    # ---------------------------------------------------- expressions
+    def _expr(self, node, held: Tuple[str, ...]):
+        if node is None:
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, held)
+            return
+        if isinstance(node, ast.Attribute):
+            path = self._self_path(node)
+            if path is not None:
+                self._record(path, False, held, node.lineno)
+                return
+            self._expr(node.value, held)
+            return
+        if isinstance(node, ast.Lambda):
+            self._expr(node.body, ())
+            return
+        for child in ast.iter_child_nodes(node):
+            self._expr(child, held)
+
+    def _call(self, node: ast.Call, held: Tuple[str, ...]):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            path = self._self_path(func)
+            if path is None:
+                self._expr(func.value, held)
+            elif len(path) == 1:
+                m = path[0]
+                if m in self.cls.methods:
+                    self._follow(self.cls, m, held)
+                else:
+                    self._record((m,), False, held, node.lineno)
+            else:
+                recv, meth = path
+                t = self.cls.attr_types.get(recv)
+                tc = self.classes.get(t) if t else None
+                if tc is not None and meth in tc.methods:
+                    self._record((recv,), False, held, node.lineno)
+                    self._follow(tc, meth, held)
+                else:
+                    threadsafe = recv in self.ts.get(self.cls.name, ())
+                    write = meth in _MUTATORS and not threadsafe
+                    self._record((recv,), write, held, node.lineno)
+        else:
+            self._expr(func, held)
+        for a in node.args:
+            self._expr(a, held)
+        for kw in node.keywords:
+            self._expr(kw.value, held)
+
+    def _write_target(self, t, held: Tuple[str, ...]):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._write_target(e, held)
+        elif isinstance(t, ast.Starred):
+            self._write_target(t.value, held)
+        elif isinstance(t, ast.Attribute):
+            path = self._self_path(t)
+            if path is not None:
+                self._record(path, True, held, t.lineno)
+            else:
+                self._expr(t.value, held)
+        elif isinstance(t, ast.Subscript):
+            path = self._self_path(t.value) \
+                if isinstance(t.value, ast.Attribute) else None
+            if path is not None:
+                self._record(path, True, held, t.lineno)
+            else:
+                self._expr(t.value, held)
+            self._expr(t.slice, held)
+
+    def _follow(self, target_cls: _ClassInfo, mname: str,
+                held: Tuple[str, ...]):
+        """One-level resolution, same depth cap as lock_checker."""
+        if self.depth >= 2:
+            return
+        sub = _RaceWalker(self.path, self.classes, self.ts, target_cls,
+                          self.role, self.method, self.accesses,
+                          self.cta, depth=self.depth + 1)
+        sub.walk_body(target_cls.methods[mname].body, held)
+
+
+def _fmt_locks(locks: FrozenSet[str]) -> str:
+    return "{%s}" % ", ".join(sorted(locks)) if locks else "no lock"
+
+
+def _analyze_class(path: str, classes: Dict[str, _ClassInfo],
+                   ts: Dict[str, Set[str]], cls: _ClassInfo,
+                   findings: List[Finding]):
+    troles = _thread_roles(cls)
+    if not any(r.startswith("thread:")
+               for rs in troles.values() for r in rs):
+        return
+    accesses: List[_Access] = []
+    cta: List[Tuple[_Access, _Access]] = []
+
+    entries: List[Tuple[str, str]] = []
+    for m, rs in sorted(troles.items()):
+        if m in cls.methods:
+            for r in sorted(rs):
+                entries.append((m, r))
+    for m in sorted(cls.methods):
+        if m == "__init__" or m in troles:
+            continue
+        if not m.startswith("_") or m in _API_DUNDERS:
+            entries.append((m, "api"))
+    for m, role in entries:
+        w = _RaceWalker(path, classes, ts, cls, role, m, accesses, cta)
+        w.walk_body(cls.methods[m].body, ())
+
+    # ---- __init__: exempt until the first thread .start() ----------
+    init = cls.methods.get("__init__")
+    post_start_writes: List[_Access] = []
+    start_line = None
+    if init is not None:
+        for c in ast.walk(init):
+            if isinstance(c, ast.Call) \
+                    and isinstance(c.func, ast.Attribute) \
+                    and c.func.attr == "start" and not c.args:
+                start_line = min(start_line or c.lineno, c.lineno)
+        if start_line is not None:
+            iacc: List[_Access] = []
+            # depth=2 disables call-following so every access line is
+            # physically inside __init__ and comparable to start_line
+            w = _RaceWalker(path, classes, ts, cls, "init", "__init__",
+                            iacc, [], depth=2)
+            w.walk_body(init.body, ())
+            post_start_writes = [a for a in iacc
+                                 if a.write and a.line > start_line]
+
+    thread_attrs = {a.attr for a in accesses
+                    if a.role.startswith("thread:")}
+    reported_ie: Set[str] = set()
+    for a in post_start_writes:
+        if a.attr in thread_attrs and a.attr not in reported_ie:
+            reported_ie.add(a.attr)
+            findings.append(Finding(
+                rule="race-init-escape",
+                message="'%s' is assigned in %s.__init__ at line %d "
+                        "AFTER the background thread starts at line "
+                        "%d; the thread can observe the attribute "
+                        "missing or half-initialized — assign before "
+                        ".start()" % (a.attr, cls.name, a.line,
+                                      start_line),
+                file=path, line=a.line, ident=a.attr))
+
+    # ---- lockset intersection per attribute ------------------------
+    by_attr: Dict[str, List[_Access]] = {}
+    for a in accesses:
+        by_attr.setdefault(a.attr, []).append(a)
+    shared: Set[str] = set()
+    unlocked_reported: Set[str] = set()
+    for attr in sorted(by_attr):
+        accs = by_attr[attr]
+        roles = {a.role for a in accs}
+        writes = [a for a in accs if a.write]
+        if len(roles) >= 2 and writes:
+            shared.add(attr)
+            inter: Optional[FrozenSet[str]] = None
+            for a in accs:
+                inter = a.locks if inter is None else inter & a.locks
+            if inter:
+                continue
+            pair = None
+            for wacc in writes:
+                for other in accs:
+                    if other.role != wacc.role \
+                            and not (wacc.locks & other.locks):
+                        pair = (wacc, other)
+                        break
+                if pair:
+                    break
+            if pair is None:
+                continue
+            w0, o0 = pair
+            findings.append(Finding(
+                rule="race-unlocked-shared-state",
+                message="'%s' is written in %s.%s() [%s] at line %d "
+                        "holding %s and accessed in %s.%s() [%s] at "
+                        "line %d holding %s — no common lock protects "
+                        "it" % (attr, cls.name, w0.method, w0.role,
+                               w0.line, _fmt_locks(w0.locks), cls.name,
+                               o0.method, o0.role, o0.line,
+                               _fmt_locks(o0.locks)),
+                file=path, line=w0.line, ident=attr))
+            unlocked_reported.add(attr)
+        elif writes:
+            # public attribute written on a background thread with no
+            # lock: external readers are an implicit unlocked role
+            public = not any(p.startswith("_")
+                             for p in attr.split(".")[1:])
+            tw = [a for a in writes
+                  if a.role.startswith("thread:") and not a.locks]
+            if public and tw:
+                shared.add(attr)
+                a0 = tw[0]
+                findings.append(Finding(
+                    rule="race-unlocked-shared-state",
+                    message="public attribute '%s' is written in "
+                            "%s.%s() on the %s thread at line %d with "
+                            "no lock held; external readers can "
+                            "observe torn or stale state"
+                            % (attr, cls.name, a0.method, a0.role,
+                               a0.line),
+                    file=path, line=a0.line, ident=attr))
+                unlocked_reported.add(attr)
+
+    # ---- check-then-act --------------------------------------------
+    seen_cta: Set[Tuple[str, int]] = set()
+    for tr, wacc in cta:
+        if tr.attr not in shared:
+            continue
+        if tr.locks & wacc.locks:
+            continue
+        if not tr.locks and not wacc.locks \
+                and tr.attr in unlocked_reported:
+            continue  # fully subsumed by race-unlocked-shared-state
+        key = (tr.attr, tr.line)
+        if key in seen_cta:
+            continue
+        seen_cta.add(key)
+        findings.append(Finding(
+            rule="race-check-then-act",
+            message="guard on '%s' at line %d (%s) and the dependent "
+                    "write at line %d (%s) in %s.%s() are not atomic "
+                    "— the state can change between test and act"
+                    % (tr.attr, tr.line, _fmt_locks(tr.locks),
+                       wacc.line, _fmt_locks(wacc.locks), cls.name,
+                       tr.method),
+            file=path, line=tr.line, ident=tr.attr))
+
+
+def analyze_race_files(paths: List[str]) -> List[Finding]:
+    """Run the static lockset race pass over ``paths``."""
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, "r") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="race-parse-error", message=str(e), file=path,
+                line=getattr(e, "lineno", 1) or 1))
+            continue
+        classes = _scan_classes(tree)
+        ts = _scan_threadsafe(tree)
+        for cls in classes.values():
+            _analyze_class(path, classes, ts, cls, findings)
+    # call-following can sight the same access through two entries —
+    # collapse identical findings
+    seen: Set[Tuple] = set()
+    unique: List[Finding] = []
+    for f in findings:
+        key = (f.rule, f.file, f.line, f.ident or f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique
+
+
+# ===========================================================================
+# runtime mode (TP_RACE_CHECK=1)
+# ===========================================================================
+
+_rt = None
+_REGISTRY: List[type] = []
+_EXEMPT_BY_CLASS: Dict[type, FrozenSet[str]] = {}
+
+
+class _AttrState:
+    __slots__ = ("threads", "lockset", "shared_write", "last")
+
+    def __init__(self):
+        self.threads: Set[int] = set()
+        self.lockset = None         # None while exclusive to one thread
+        self.shared_write = False   # write observed after going shared
+        self.last: Dict[int, Tuple[str, Tuple[str, ...]]] = {}
+
+
+class _RaceRuntime:
+    def __init__(self, owns_lock_checker: bool):
+        self.owns_lock_checker = owns_lock_checker
+        # raw (unchecked) lock: the tracker must never feed back into
+        # the lock-order checker's held stacks
+        self.mutex = _lc._state.originals["Lock"]()
+        self.reported: Set[Tuple[int, str]] = set()
+        self.members: Dict[type, FrozenSet[str]] = {}
+        self.exempt: Dict[type, FrozenSet[str]] = {}
+        self.patched: List[Tuple] = []
+        self.states: Dict[int, Dict] = {}  # fallback for __slots__
+
+
+def _held_ids() -> FrozenSet[Tuple[int, str]]:
+    st = _lc._state
+    if st is None:
+        return frozenset()
+    return frozenset((id(l), l.site) for l in st.held())
+
+
+def _short_stack(skip: int) -> Tuple[str, ...]:
+    f = sys._getframe(skip)
+    out = []
+    while f is not None and len(out) < 6:
+        out.append("%s:%d in %s" % (f.f_code.co_filename, f.f_lineno,
+                                    f.f_code.co_name))
+        f = f.f_back
+    return tuple(out)
+
+
+def _track(obj, name: str, is_write: bool):
+    rt = _rt
+    if rt is None or name.startswith("__") \
+            or name.startswith("_tp_race"):
+        return
+    t = type(obj)
+    members = rt.members.get(t)
+    if members is None:
+        members = rt.members[t] = frozenset(dir(t))
+        ex: FrozenSet[str] = frozenset()
+        for c in t.__mro__:
+            ex = ex | _EXEMPT_BY_CLASS.get(c, frozenset())
+        rt.exempt[t] = ex
+    if name in members or name in rt.exempt[t]:
+        return
+    try:
+        state = object.__getattribute__(obj, "_tp_race_state")
+    except AttributeError:
+        state = {}
+        try:
+            object.__setattr__(obj, "_tp_race_state", state)
+        except AttributeError:
+            state = rt.states.setdefault(id(obj), {})
+    tid = threading.get_ident()
+    held = _held_ids()
+    stack = _short_stack(3)
+    with rt.mutex:
+        st = state.get(name)
+        if st is None:
+            st = state[name] = _AttrState()
+        st.threads.add(tid)
+        st.last[tid] = (threading.current_thread().name, stack)
+        if len(st.threads) < 2:
+            return  # exclusive: no refinement until a second thread
+        st.lockset = held if st.lockset is None else st.lockset & held
+        if is_write:
+            st.shared_write = True
+        if st.shared_write and not st.lockset:
+            key = (id(obj), name)
+            if key in rt.reported:
+                return
+            rt.reported.add(key)
+            other = next((v for k, v in st.last.items() if k != tid),
+                         ("?", ()))
+            raise MXNetError(
+                "data race on %s.%s (TP_RACE_CHECK): candidate "
+                "lockset empty after multi-thread access with "
+                "writes.\n  this thread (%s):\n    %s\n  other "
+                "thread (%s):\n    %s"
+                % (t.__name__, name, st.last[tid][0],
+                   "\n    ".join(st.last[tid][1]), other[0],
+                   "\n    ".join(other[1])))
+
+
+def _patch_class(cls: type):
+    if any("_tp_race_wrapped" in b.__dict__ for b in cls.__mro__):
+        return  # a base is already instrumented — inherited
+    orig_get = cls.__getattribute__
+    orig_set = cls.__setattr__
+
+    def __getattribute__(self, name):
+        value = orig_get(self, name)
+        _track(self, name, False)
+        return value
+
+    def __setattr__(self, name, value):
+        orig_set(self, name, value)
+        _track(self, name, True)
+
+    had_get = "__getattribute__" in cls.__dict__
+    had_set = "__setattr__" in cls.__dict__
+    cls.__getattribute__ = __getattribute__
+    cls.__setattr__ = __setattr__
+    cls._tp_race_wrapped = True
+    _rt.patched.append((cls, had_get, orig_get, had_set, orig_set))
+
+
+def race_audit(cls=None, *, exempt=()):
+    """Mark a threaded class for runtime race auditing.
+
+    ``exempt`` lists attributes excluded from lockset refinement —
+    by-design lock-free state (GIL-atomic monotonic flags, snapshot
+    mirrors) that the static pass carries a written suppression for.
+    Without ``TP_RACE_CHECK=1`` the decorator only records the class.
+    """
+    def deco(c):
+        _EXEMPT_BY_CLASS[c] = _EXEMPT_BY_CLASS.get(c, frozenset()) \
+            | frozenset(exempt)
+        _REGISTRY.append(c)
+        if _rt is not None:
+            _patch_class(c)
+            _rt.members.clear()
+            _rt.exempt.clear()
+        return c
+    if cls is not None:
+        return deco(cls)
+    return deco
+
+
+def install_race_checker():
+    """Arm the Eraser-mode attribute tracker (idempotent).  Installs
+    the ``TP_LOCK_CHECK`` lock patches too — the race checker reads
+    its per-thread held stacks."""
+    global _rt
+    if _rt is not None:
+        return
+    from .lock_checker import (install_runtime_checker,
+                               runtime_checker_active)
+    owns = not runtime_checker_active()
+    install_runtime_checker()
+    _rt = _RaceRuntime(owns)
+    for cls in list(_REGISTRY):
+        _patch_class(cls)
+
+
+def uninstall_race_checker():
+    """Restore the audited classes' attribute access."""
+    global _rt
+    if _rt is None:
+        return
+    for cls, had_get, og, had_set, os_ in _rt.patched:
+        if had_get:
+            cls.__getattribute__ = og
+        else:
+            del cls.__getattribute__
+        if had_set:
+            cls.__setattr__ = os_
+        else:
+            del cls.__setattr__
+        del cls._tp_race_wrapped
+    owns = _rt.owns_lock_checker
+    _rt = None
+    if owns:
+        from .lock_checker import uninstall_runtime_checker
+        uninstall_runtime_checker()
+
+
+def race_checker_active() -> bool:
+    return _rt is not None

@@ -51,8 +51,8 @@ E5M2 = jnp.float8_e5m2
 def native_fp8_dot() -> bool:
     """Whether to hand fp8 operands to the MXU directly.  ``TP_FP8_NATIVE``
     forces (1) or forbids (0); default: native on TPU, emulate elsewhere."""
-    ov = get_env("FP8_NATIVE")
-    if ov is not None and str(ov) != "":
+    ov = get_env("FP8_NATIVE", "auto")
+    if ov is not None and str(ov) not in ("", "auto"):
         return str(ov) not in ("0", "false", "False")
     return jax.default_backend() == "tpu"
 

@@ -62,6 +62,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import telemetry
+from ..analysis.race_checker import race_audit
 from ..base import MXNetError, get_env
 from ..overlap import drain_target
 from . import faults
@@ -208,6 +209,11 @@ def _tree_bytes(state) -> int:
 # ---------------------------------------------------------------------------
 
 
+# the host-side mirrors are written under _mirror_lock but read
+# lock-free by benches/tests (monitoring-only), so they sit outside
+# lockset refinement
+@race_audit(exempt=("saves_completed", "gc_removed",
+                    "last_save_seconds", "last_restore_seconds"))
 class CheckpointManager:
     """Periodic (optionally async) checkpointing with commit markers,
     keep-last-N GC, corrupt-checkpoint fallback, and preemption saves.
@@ -230,7 +236,10 @@ class CheckpointManager:
         self.keep_last = int(keep_last)
         self.async_save = bool(async_save)
         os.makedirs(self.directory, exist_ok=True)
-        # host-side mirrors (benches/tests read these without telemetry)
+        # host-side mirrors (benches/tests read these without telemetry);
+        # written by the async writer thread AND by sync-mode callers, so
+        # every access goes through _mirror_lock
+        self._mirror_lock = threading.Lock()
         self.saves_completed = 0
         self.gc_removed = 0
         self.last_save_seconds = 0.0
@@ -387,7 +396,8 @@ class CheckpointManager:
 
     # -------------------------------------------------------------- internals
     def _check_writer(self) -> None:
-        exc = self._writer_exc
+        with self._mirror_lock:
+            exc = self._writer_exc
         if exc is not None:
             raise exc
 
@@ -433,12 +443,15 @@ class CheckpointManager:
             try:
                 if job is None:
                     return
-                if self._writer_exc is None:
+                with self._mirror_lock:
+                    failed = self._writer_exc is not None
+                if not failed:
                     self._write(*job)
             except BaseException as exc:  # noqa: BLE001 — reported fail-fast
                 # captured, surfaced on the next step boundary; keep
                 # draining so queue.join() can never hang
-                self._writer_exc = exc
+                with self._mirror_lock:
+                    self._writer_exc = exc
                 logging.error("resilience: async checkpoint writer failed "
                               "(%r) — surfacing at the next step boundary",
                               exc)
@@ -473,8 +486,9 @@ class CheckpointManager:
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(final, _COMMIT))
         dt = time.monotonic() - t0
-        self.saves_completed += 1
-        self.last_save_seconds = dt
+        with self._mirror_lock:
+            self.saves_completed += 1
+            self.last_save_seconds = dt
         telemetry.counter("ckpt_saves_total",
                           {"mode": "async" if self._queue is not None
                            else "sync"}).inc()
@@ -487,7 +501,8 @@ class CheckpointManager:
         victims = steps[:-self.keep_last] if self.keep_last > 0 else []
         for step in victims:
             shutil.rmtree(self.step_path(step), ignore_errors=True)
-            self.gc_removed += 1
+            with self._mirror_lock:
+                self.gc_removed += 1
             telemetry.counter("ckpt_gc_total").inc()
         if not steps:
             return
@@ -497,5 +512,6 @@ class CheckpointManager:
             if step < newest and \
                     not os.path.exists(os.path.join(path, _COMMIT)):
                 shutil.rmtree(path, ignore_errors=True)
-                self.gc_removed += 1
+                with self._mirror_lock:
+                    self.gc_removed += 1
                 telemetry.counter("ckpt_gc_total").inc()

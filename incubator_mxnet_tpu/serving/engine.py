@@ -39,6 +39,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import telemetry
+from ..analysis.race_checker import race_audit
 from ..base import MXNetError, get_env
 
 __all__ = ["InferenceEngine", "bucket_batch", "bucket_length"]
@@ -113,6 +114,7 @@ class ServeStats:
             (bucket - n) / bucket)
 
 
+@race_audit
 class InferenceEngine:
     """Dynamic batcher over a stacked-batch forward function.
 
@@ -313,8 +315,9 @@ class InferenceEngine:
             return
         now = time.monotonic()
         telemetry.histogram("serve_batch_seconds").observe(now - t0)
+        with self.stats.lock:
+            self.stats.requests += len(group)
         for i, p in enumerate(group):
-            self.stats.requests += 1
             telemetry.counter("serve_requests_total").inc()
             telemetry.histogram("serve_request_seconds").observe(
                 now - p.t_submit)

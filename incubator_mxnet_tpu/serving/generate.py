@@ -45,6 +45,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import telemetry
+from ..analysis.race_checker import race_audit
 from ..base import MXNetError, get_env
 from .engine import ServeStats, bucket_batch, bucket_length
 
@@ -166,7 +167,7 @@ class KVTransformerLM:
         # KV-cache storage dtype (TP_KV_DTYPE): bf16 halves cache HBM;
         # attention still accumulates in f32 (reads upcast, writes cast)
         if kv_dtype is None:
-            kv_dtype = get_env("KV_DTYPE") or None
+            kv_dtype = get_env("KV_DTYPE", "float32")
         if not kv_dtype:
             kv_dtype = "float32"
         if kv_dtype not in _KV_DTYPES:
@@ -571,6 +572,13 @@ class _Seq:
                 and self.generated[-1] == self.req.stop_token)
 
 
+# exempt mirrors the static suppressions: the slot tables and the KV
+# cache handles are loop-thread-owned after __init__ (Thread.start is
+# the happens-before edge; active_slots is an advisory cross-thread
+# scan) and the public counters are monitoring mirrors whose unlocked
+# external reads are by design
+@race_audit(exempt=("_seqs", "_lengths", "_cache_k", "_cache_v",
+                    "_key", "prefill_tokens", "active_high_water"))
 class GenerationEngine:
     """Continuous-batching generation server over a
     :class:`KVTransformerLM`.
@@ -649,7 +657,8 @@ class GenerationEngine:
             if self._closed:
                 raise MXNetError("engine %r is closed" % self.name)
             if len(self._pending) >= self.max_queue:
-                self.stats.rejected += 1
+                with self.stats.lock:
+                    self.stats.rejected += 1
                 telemetry.counter("serve_rejected_total").inc()
                 raise MXNetError(
                     "serve queue full (%d >= max_queue=%d): backpressure"
@@ -700,7 +709,8 @@ class GenerationEngine:
         alive = []
         for p in self._pending:
             if p.deadline is not None and now > p.deadline:
-                self.stats.expired += 1
+                with self.stats.lock:
+                    self.stats.expired += 1
                 telemetry.counter("serve_deadline_expired_total").inc()
                 p.future.set_exception(MXNetError(
                     "request deadline expired after %.1f ms in queue"
@@ -731,6 +741,13 @@ class GenerationEngine:
                     self._decode_step()
             except Exception as e:  # noqa: BLE001 — fail the sequences
                 self._fail_all(e)
+                # requests admitted but not yet seated in a slot (the
+                # failure hit _admit before the slot assignment) are
+                # invisible to _fail_all — fail them too or their
+                # futures hang forever
+                for r in admitted:
+                    if not r.future.done():
+                        r.future.set_exception(e)
 
     def _take_admissible(self) -> List[_GenPending]:
         """Pull as many pending requests as there are free slots (must
@@ -783,9 +800,10 @@ class GenerationEngine:
                     toks[j, :r.tokens.size] = r.tokens
                     lens[j] = r.tokens.size
                     slots[j] = free[j]
-                    self.prefill_tokens += int(r.tokens.size)
-                telemetry.counter("serve_prefill_tokens_total").inc(
-                    int(sum(r.tokens.size for r in chunk)))
+                npref = int(sum(r.tokens.size for r in chunk))
+                with self._cond:
+                    self.prefill_tokens += npref
+                telemetry.counter("serve_prefill_tokens_total").inc(npref)
                 self._cache_k, self._cache_v, logits = \
                     self.model.prefill(self._cache_k, self._cache_v,
                                        toks, lens, slots)
@@ -793,6 +811,7 @@ class GenerationEngine:
                 now = time.monotonic()
                 for j, r in enumerate(chunk):
                     seq = _Seq(r, free[j], r.tokens.size)
+                    # tp-lint: disable=race-unlocked-shared-state -- the slot table is loop-thread-owned after construction; the cross-thread active_slots scan is an advisory monitoring read of GIL-atomic list cells
                     self._seqs[free[j]] = seq
                     self._lengths[free[j]] = r.tokens.size
                     self._emit(seq, logits[j], now)
@@ -828,7 +847,8 @@ class GenerationEngine:
             seq.req.tokens.size, seq.slot,
             seq.t_first - seq.req.t_submit)
         self._release(seq.slot)
-        self.stats.requests += 1
+        with self.stats.lock:
+            self.stats.requests += 1
         telemetry.counter("serve_requests_total").inc()
         telemetry.counter("serve_slot_recycles_total").inc()
         telemetry.histogram("serve_request_seconds").observe(
@@ -846,8 +866,9 @@ class GenerationEngine:
                 active.append(seq)
         if not active:
             return
-        self.active_high_water = max(self.active_high_water,
-                                     len(active))
+        with self._cond:
+            self.active_high_water = max(self.active_high_water,
+                                         len(active))
         telemetry.histogram("serve_decode_active").observe(len(active))
         logits = np.asarray(self._decode_batch(tokens))
         now = time.monotonic()

@@ -1,0 +1,106 @@
+"""Seeded data-race violations for tests/test_analysis.py.
+
+Never imported — parsed by the static race checker only.
+"""
+import threading
+
+
+class UnlockedCounter:
+    """Shared counter mutated by both roles with no common lock."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.hits = 0
+        self._t = threading.Thread(target=self._worker, daemon=True)
+        self._t.start()
+
+    def _worker(self):
+        while True:
+            self.hits += 1  # SEED:unlocked-write
+
+    def snapshot(self):
+        return self.hits
+
+
+class CheckThenAct:
+    """Guard read outside the lock taken for the dependent write."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.items = []
+        self._t = threading.Thread(target=self._worker, daemon=True)
+        self._t.start()
+
+    def _worker(self):
+        while True:
+            with self.lock:
+                self.items.append(1)
+
+    def take(self):
+        if self.items:  # SEED:check-then-act
+            with self.lock:
+                return self.items.pop()
+        return None
+
+
+class InitEscape:
+    """Attribute published to the thread after it already started."""
+
+    def __init__(self):
+        self._t = threading.Thread(target=self._worker, daemon=True)
+        self._t.start()
+        self.config = {"ready": True}  # SEED:init-escape
+
+    def _worker(self):
+        while not self.config:
+            pass
+
+
+class PublishedStats:
+    """Public mirror updated on the worker with no lock — external
+    readers are an implicit unlocked role."""
+
+    def __init__(self):
+        self.processed = 0
+        self._t = threading.Thread(target=self._worker, daemon=True)
+        self._t.start()
+
+    def _worker(self):
+        while True:
+            self.processed += 1  # SEED:public-mirror
+
+
+class GuardedCounter:
+    """Every access under the one lock — the pass must stay quiet."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.hits = 0
+        self._t = threading.Thread(target=self._worker, daemon=True)
+        self._t.start()
+
+    def _worker(self):
+        while True:
+            with self.lock:
+                self.hits += 1  # SEED:ok-guarded
+
+    def snapshot(self):
+        with self.lock:
+            return self.hits
+
+
+class SuppressedFlag:
+    """A by-design GIL-atomic flag with a written justification."""
+
+    def __init__(self):
+        self.running = True
+        self._t = threading.Thread(target=self._worker, daemon=True)
+        self._t.start()
+
+    def _worker(self):
+        while self.running:
+            pass
+
+    def stop(self):
+        # tp-lint: disable=race-unlocked-shared-state -- GIL-atomic bool
+        self.running = False  # SEED:suppressed

@@ -16,9 +16,10 @@ import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu.analysis import (
-    analyze_lock_files, check_env_drift, filter_suppressed,
-    install_runtime_checker, lint_tracing_file, load_suppressions,
-    uninstall_runtime_checker, verify_graph)
+    analyze_lock_files, analyze_race_files, check_env_drift,
+    filter_suppressed, install_race_checker, install_runtime_checker,
+    lint_tracing_file, load_suppressions, race_audit,
+    uninstall_race_checker, uninstall_runtime_checker, verify_graph)
 from incubator_mxnet_tpu.base import MXNetError
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -301,6 +302,128 @@ def test_ckpt_writer_shutdown_under_runtime_checker(runtime_checker,
 
 
 # =====================================================================
+# race checker — static (lockset analysis)
+# =====================================================================
+
+def test_race_fixture_catches_seeded_violations():
+    path = os.path.join(FIXTURES, "bad_races.py")
+    seeds = _seed_lines(path)
+    findings = analyze_race_files([path])
+    fs = _by_rule(findings)
+
+    unlocked = {f.line for f in fs["race-unlocked-shared-state"]}
+    assert seeds["unlocked-write"] in unlocked
+    assert seeds["public-mirror"] in unlocked
+    assert seeds["suppressed"] in unlocked  # pre-filter
+    assert seeds["check-then-act"] in \
+        {f.line for f in fs["race-check-then-act"]}
+    assert seeds["init-escape"] in \
+        {f.line for f in fs["race-init-escape"]}
+    # the fully lock-disciplined class stays silent
+    assert seeds["ok-guarded"] not in {f.line for f in findings}
+    # every race finding carries its attr identity (SARIF fingerprints)
+    assert all(f.ident for f in findings)
+    # the justified suppression is honored, the others survive
+    kept = {f.line for f in filter_suppressed(findings)}
+    assert seeds["suppressed"] not in kept
+    assert seeds["unlocked-write"] in kept
+
+
+def test_race_static_pass_clean_on_threaded_modules():
+    mods = ["serving/engine.py", "serving/generate.py", "io.py",
+            "resilience/manager.py", "ps.py"]
+    paths = [os.path.join(REPO, "incubator_mxnet_tpu", m)
+             for m in mods]
+    assert filter_suppressed(analyze_race_files(paths)) == []
+
+
+# =====================================================================
+# race checker — runtime (TP_RACE_CHECK)
+# =====================================================================
+
+@pytest.fixture
+def race_runtime():
+    install_race_checker()
+    try:
+        yield
+    finally:
+        uninstall_race_checker()
+
+
+def test_runtime_race_unlocked_write_raises(race_runtime):
+    @race_audit
+    class Shared:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.count = 0
+
+    obj = Shared()  # first access on the main thread
+    errs = []
+
+    def worker():
+        try:
+            obj.count += 1  # second thread, no lock — lockset empties
+        except MXNetError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(timeout=10)
+    assert len(errs) == 1
+    msg = str(errs[0])
+    assert "data race" in msg and "Shared.count" in msg
+    # the report carries both threads' stacks
+    assert "MainThread" in msg and "worker" in msg
+
+
+def test_runtime_race_guarded_and_exempt_stay_silent(race_runtime):
+    @race_audit(exempt=("mirror",))
+    class Guarded:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.count = 0
+            self.mirror = 0
+
+    obj = Guarded()
+
+    def worker():
+        with obj.lock:
+            obj.count += 1
+        obj.mirror += 1  # exempt: lock-free by design
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(timeout=10)
+    with obj.lock:
+        obj.count += 1
+        assert obj.count == 2
+    obj.mirror += 1
+    assert obj.mirror == 2
+
+
+@pytest.mark.slow
+def test_serving_and_ckpt_clean_under_race_checker():
+    """The serving mixed-load and checkpoint kill/crash tests run with
+    the Eraser tracker armed (TP_RACE_CHECK=1) and report nothing —
+    the audited engines hold their declared locking discipline under
+    real concurrency."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TP_RACE_CHECK="1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q",
+         "-p", "no:cacheprovider", "-p", "no:randomly",
+         "tests/test_serving.py::"
+         "test_generation_compile_bound_under_mixed_load",
+         "tests/test_resilience.py::"
+         "test_mid_save_crash_falls_back_to_previous_commit",
+         "tests/test_resilience.py::"
+         "test_fused_kill_at_step_k_resumes_bit_exact[3]"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "data race" not in proc.stdout + proc.stderr
+
+
+# =====================================================================
 # env drift
 # =====================================================================
 
@@ -325,6 +448,28 @@ def test_env_drift_fixture(tmp_path):
     assert undoc.file.endswith("mod.py") and undoc.line == 4
     (unread,) = fs["env-unread"]
     assert "TP_GAMMA" in unread.message
+
+
+def test_env_default_drift_fixture(tmp_path):
+    pkg = tmp_path / "incubator_mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "from .base import get_env\n"
+        "a = get_env('ALPHA', 2, int)\n"
+        "b = get_env('BETA', 'auto')\n"
+        "c = get_env('GAMMA')\n"
+        "d = get_env('DELTA', 0.5, float)\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "env_var.md").write_text(
+        "| `TP_ALPHA` | 1 | drifted: code falls back to 2 |\n"
+        "| `TP_BETA` | `auto` | matches |\n"
+        "| `TP_GAMMA` | — | no default on either side |\n"
+        "| `TP_DELTA` | half of the window | descriptive — skipped |\n")
+    fs = _by_rule(check_env_drift(str(tmp_path)))
+    (drift,) = fs["env-default-drift"]
+    assert "TP_ALPHA" in drift.message and drift.ident == "TP_ALPHA"
+    assert drift.file.endswith("mod.py") and drift.line == 2
 
 
 def test_env_drift_repo_clean():
@@ -368,10 +513,45 @@ def _run_lint(*args):
 
 
 def test_repo_lint_fast_passes_clean():
-    """tracing + locks + env are pure-AST: run them in-suite."""
+    """tracing + locks + env + races are pure-AST: run them in-suite."""
     proc = _run_lint("--pass", "tracing", "--pass", "locks",
-                     "--pass", "env")
+                     "--pass", "env", "--pass", "races")
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_sarif_output_and_stable_fingerprints(tmp_path):
+    """--sarif emits SARIF 2.1.0 whose fingerprints key on rule + file
+    + attr identity: shifting every line must not change them."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tp_lint_cli", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+
+    with open(os.path.join(FIXTURES, "bad_races.py")) as f:
+        src = f.read()
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    first = lint.to_sarif(analyze_race_files([str(p)]))
+    p.write_text("# pushed down one line\n" + src)
+    second = lint.to_sarif(analyze_race_files([str(p)]))
+
+    assert first["version"] == "2.1.0"
+    res1 = first["runs"][0]["results"]
+    res2 = second["runs"][0]["results"]
+    assert res1 and len(res1) == len(res2)
+
+    def fingerprints(results):
+        return sorted(r["partialFingerprints"]["tpLintFingerprint/v1"]
+                      for r in results)
+
+    def lines(results):
+        return [r["locations"][0]["physicalLocation"]["region"]
+                ["startLine"] for r in results]
+
+    assert fingerprints(res1) == fingerprints(res2)
+    assert lines(res1) != lines(res2)  # the locations did move
 
 
 @pytest.mark.slow

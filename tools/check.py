@@ -35,8 +35,9 @@ Checks, in order:
    ``TP_CHECK_FAULT=0`` skips);
 10. **static-analysis** — the ``tools/lint.py`` suite (graph verifier
    over the model zoo, tracing-hazard lint, lock-order checker,
-   env-knob drift; docs/static_analysis.md): zero unsuppressed
-   findings (needs jax — skip with ``TP_CHECK_LINT=0``).
+   lockset race detector, env-knob drift incl. documented defaults;
+   docs/static_analysis.md): zero unsuppressed findings (needs jax —
+   skip with ``TP_CHECK_LINT=0``).
 
 Exit code 0 = clean; 1 = findings (printed one per line).
 """
@@ -337,9 +338,10 @@ def check_resilience(problems):
 def check_static_analysis(problems):
     """Static-analysis gate (docs/static_analysis.md): run the full
     ``tools/lint.py`` suite — graph verifier over the model zoo,
-    tracing-hazard lint over the package, the lock-order checker over
-    the threaded modules, and the env-knob drift pass — requiring zero
-    unsuppressed findings (needs jax — skip with ``TP_CHECK_LINT=0``)."""
+    tracing-hazard lint over the package, the lock-order checker and
+    lockset race detector over the threaded modules, and the env-knob
+    drift pass — requiring zero unsuppressed findings (needs jax —
+    skip with ``TP_CHECK_LINT=0``)."""
     if os.environ.get("TP_CHECK_LINT", "1") == "0":
         return
     import subprocess

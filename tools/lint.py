@@ -7,13 +7,15 @@ passes over the repo and exits non-zero on any unsuppressed finding.
     python tools/lint.py                 # all passes
     python tools/lint.py --pass locks    # one pass
     python tools/lint.py --json          # machine-readable findings
+    python tools/lint.py --sarif         # SARIF 2.1.0 for CI annotations
 
 Passes: ``graph`` (verify every model-zoo Symbol plus a data-parallel
 spec check), ``tracing`` (AST hazards in jitted code), ``locks``
 (static lock-order graph over the threaded modules), ``env``
-(``TP_*`` knob ⟷ ``docs/env_var.md`` drift).  Suppress individual
-findings in source with ``# tp-lint: disable=<rule> -- why`` (see
-``docs/static_analysis.md``).
+(``TP_*`` knob ⟷ ``docs/env_var.md`` drift, incl. documented
+defaults), ``races`` (per-class lockset data-race detection over the
+same threaded modules).  Suppress individual findings in source with
+``# tp-lint: disable=<rule> -- why`` (see ``docs/static_analysis.md``).
 
 ``tools/check.py`` runs this as a default-on gate (``TP_CHECK_LINT=0``
 skips).
@@ -28,10 +30,10 @@ sys.path.insert(0, REPO_ROOT)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-PASSES = ("graph", "tracing", "locks", "env")
+PASSES = ("graph", "tracing", "locks", "env", "races")
 
-# the threaded modules the lock pass covers — modules that create
-# threading primitives and run background threads
+# the threaded modules the lock and race passes cover — modules that
+# create threading primitives and run background threads
 LOCK_MODULES = [
     "incubator_mxnet_tpu/serving/engine.py",
     "incubator_mxnet_tpu/serving/generate.py",
@@ -120,12 +122,23 @@ def run_env_pass():
     return check_env_drift(REPO_ROOT)
 
 
+def run_races_pass():
+    from incubator_mxnet_tpu.analysis import analyze_race_files
+
+    paths = [os.path.join(REPO_ROOT, p) for p in LOCK_MODULES
+             if os.path.exists(os.path.join(REPO_ROOT, p))]
+    return analyze_race_files(paths)
+
+
 def run_suppression_audit():
-    """Malformed ``tp-lint`` directives are findings themselves."""
+    """Malformed ``tp-lint`` directives are findings themselves.  The
+    lint fixtures are audited too: seeded files may carry (and tests
+    rely on) well-formed suppressions."""
     from incubator_mxnet_tpu.analysis import load_suppressions
 
     findings = []
-    for root in ("incubator_mxnet_tpu", "tools", "examples"):
+    for root in ("incubator_mxnet_tpu", "tools", "examples",
+                 os.path.join("tests", "fixtures", "lint")):
         top = os.path.join(REPO_ROOT, root)
         if not os.path.isdir(top):
             continue
@@ -139,6 +152,52 @@ def run_suppression_audit():
     return findings
 
 
+def _stable_id(f):
+    """Fingerprint stable under line churn: rule + path + identity.
+
+    Findings carrying an ``ident`` (lock/attr/knob name) key on it;
+    the rest hash their message with line numbers stripped, so a
+    baseline diff only flips when the finding itself changes.
+    """
+    import hashlib
+    import re
+
+    ident = f.ident
+    if not ident:
+        norm = re.sub(r":\d+|line \d+", "", f.message)
+        ident = hashlib.sha1(norm.encode()).hexdigest()[:12]
+    return "%s:%s:%s" % (f.rule, f.file or f.node or "", ident)
+
+
+def to_sarif(findings):
+    """SARIF 2.1.0 log for CI annotation rendering/baseline diffing."""
+    rules = sorted({f.rule for f in findings})
+    results = []
+    for f in findings:
+        loc = {"physicalLocation": {
+            "artifactLocation": {"uri": str(f.file) if f.file else "<graph>"},
+            "region": {"startLine": int(f.line or 1)}}}
+        if f.node:
+            loc["logicalLocations"] = [{"name": f.node}]
+        results.append({
+            "ruleId": f.rule,
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "locations": [loc],
+            "partialFingerprints": {
+                "tpLintFingerprint/v1": _stable_id(f)},
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{"tool": {"driver": {
+            "name": "tp-lint",
+            "informationUri": "docs/static_analysis.md",
+            "rules": [{"id": r} for r in rules]}},
+            "results": results}],
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="incubator_mxnet_tpu static-analysis suite")
@@ -147,6 +206,9 @@ def main(argv=None):
                     help="run only this pass (repeatable); default all")
     ap.add_argument("--json", action="store_true",
                     help="emit findings as JSON for telemetry ingestion")
+    ap.add_argument("--sarif", action="store_true",
+                    help="emit findings as SARIF 2.1.0 (stable "
+                         "fingerprints for CI baselines)")
     args = ap.parse_args(argv)
 
     selected = set(args.passes or ["all"])
@@ -157,7 +219,8 @@ def main(argv=None):
 
     findings = []
     runners = {"graph": run_graph_pass, "tracing": run_tracing_pass,
-               "locks": run_locks_pass, "env": run_env_pass}
+               "locks": run_locks_pass, "env": run_env_pass,
+               "races": run_races_pass}
     for name in PASSES:
         if name in selected:
             findings.extend(runners[name]())
@@ -169,7 +232,9 @@ def main(argv=None):
             f.file = os.path.relpath(f.file, REPO_ROOT)
     findings.sort(key=lambda f: (f.file or "", f.line or 0, f.rule))
 
-    if args.json:
+    if args.sarif:
+        print(json.dumps(to_sarif(findings), indent=2))
+    elif args.json:
         print(json.dumps({"findings": [f.to_dict() for f in findings],
                           "count": len(findings)}, indent=2))
     else:
