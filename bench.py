@@ -412,6 +412,126 @@ def _paged_serving_record(small):
     return record
 
 
+def _speculative_record(small):
+    """Speculative-decoding sub-record (docs/speculative_decoding.md):
+    engine decode tokens/s at batch 1 and the full slot batch for
+    k ∈ {0, 2, 4} with f32 and int8 same-architecture drafts (the
+    acceptance rate rides along — with the f32 twin it is 1.0, so the
+    k≥2 batch-1 speedup is the verify-pass win, not draft luck), plus
+    a chunked-vs-unchunked long-prompt offered-load A/B recording TTFT
+    p50/p99 and decode throughput under the deadline SLO
+    (``TP_BENCH_SERVE_SLO_MS``) — head-of-line blocking is what
+    chunking removes."""
+    from incubator_mxnet_tpu import serving
+
+    rng = np.random.RandomState(0)
+    V, E, H, NL, S = (32, 32, 4, 1, 64) if small else (512, 256, 8, 4, 256)
+    slots = 4 if small else 8
+    new_tokens = 8 if small else 32
+    params = _toy_lm_params(rng, V, E, NL, S)
+    model = serving.KVTransformerLM(params, heads=H)
+    prompt = rng.randint(0, V, size=8).astype(np.int32)
+    record = {"metric": "speculative_decode_tokens_per_sec",
+              "unit": "tokens/s", "vocab": V, "embed": E, "layers": NL,
+              "max_len": S, "new_tokens": new_tokens, "slots": slots}
+
+    def timed(eng, bs):
+        # untimed pass first: compiles every program this batch shape
+        # needs (prefill/verify/sample), so the timed pass is steady-state
+        for f in [eng.submit(prompt, max_new_tokens=new_tokens)
+                  for _ in range(bs)]:
+            f.result(timeout=600)
+        t0 = time.perf_counter()
+        for f in [eng.submit(prompt, max_new_tokens=new_tokens)
+                  for _ in range(bs)]:
+            f.result(timeout=600)
+        dt = time.perf_counter() - t0
+        return round(bs * new_tokens / dt, 1)
+
+    with serving.SpeculativeGenerationEngine(
+            model, spec_k=0, max_slots=slots, max_len=S) as eng:
+        record["k0"] = {
+            "batch1_tokens_per_sec": timed(eng, 1),
+            "batch%d_tokens_per_sec" % slots: timed(eng, slots)}
+    for wdt, name in ((None, "f32_draft"), ("int8", "int8_draft")):
+        variants = {}
+        for k in (2, 4):
+            draft = serving.DraftModel(serving.KVTransformerLM(
+                params, heads=H, weight_dtype=wdt))
+            with serving.SpeculativeGenerationEngine(
+                    model, draft=draft, spec_k=k, max_slots=slots,
+                    max_len=S) as eng:
+                variants["k%d" % k] = {
+                    "batch1_tokens_per_sec": timed(eng, 1),
+                    "batch%d_tokens_per_sec" % slots: timed(eng, slots),
+                    "accept_rate": round(
+                        eng.spec_accepted
+                        / max(1, eng.spec_proposed), 3)}
+        record[name] = variants
+    record["value"] = record["f32_draft"]["k4"]["batch1_tokens_per_sec"]
+    record["batch1_speedup_k4"] = round(
+        record["value"] / record["k0"]["batch1_tokens_per_sec"], 2)
+
+    # chunked-vs-unchunked: long prompts bursting in alongside short
+    # ones — unchunked, each long prefill stalls every running decode
+    slo_ms = float(os.environ.get("TP_BENCH_SERVE_SLO_MS", "10000"))
+    long_len = S - new_tokens - 2
+    chunk = 16 if small else 64
+    n_long = 6 if small else 16
+    longs = [rng.randint(0, V, size=long_len).astype(np.int32)
+             for _ in range(n_long)]
+    shorts = [rng.randint(0, V, size=6).astype(np.int32)
+              for _ in range(n_long)]
+
+    def ttft_ab(chunk_tokens):
+        def burst(eng, deadline=None):
+            futs = []
+            for sp, lp in zip(shorts, longs):
+                for p in (sp, lp):
+                    futs.append(eng.submit(
+                        p, max_new_tokens=new_tokens,
+                        deadline_ms=deadline))
+            return futs
+
+        with serving.SpeculativeGenerationEngine(
+                model, spec_k=0, prefill_chunk=chunk_tokens,
+                max_slots=slots, max_len=S) as eng:
+            # untimed identical burst first: compiles every
+            # (batch-bucket, length-bucket) combination the timed
+            # burst hits, chunk programs included
+            for f in burst(eng):
+                f.result(timeout=600)
+            c0 = eng.prefill_chunks
+            t0 = time.perf_counter()
+            futs = burst(eng, deadline=slo_ms)
+            tt = []
+            ok = expired = 0
+            for f in futs:
+                try:
+                    tt.append(f.result(timeout=600).ttft_s)
+                    ok += 1
+                except Exception:
+                    expired += 1
+            dt = time.perf_counter() - t0
+            out = {"prefill_chunk": chunk_tokens, "ok": ok,
+                   "expired": expired,
+                   "throughput_tokens_per_sec":
+                       round(ok * new_tokens / dt, 1),
+                   "chunks": eng.prefill_chunks - c0}
+            if tt:
+                out["ttft_p50_ms"] = round(
+                    float(np.percentile(tt, 50)) * 1e3, 2)
+                out["ttft_p99_ms"] = round(
+                    float(np.percentile(tt, 99)) * 1e3, 2)
+            return out
+
+    record["chunked_ttft"] = {
+        "slo_ms": slo_ms, "long_prompt_tokens": long_len,
+        "offered": 2 * n_long, "unchunked": ttft_ab(0),
+        "chunked": ttft_ab(chunk)}
+    return record
+
+
 def _quantization_record(small):
     """Quantization sub-record (docs/quantization.md): decode tokens/s
     with int8 weight-only vs f32 weights at batch 1 and batch 8 — the
@@ -721,6 +841,10 @@ def main():
     # at equal KV HBM, deadline-SLO goodput under an offered-load
     # sweep, the slot-capacity ratio, and the prefix-cache hit pass
     combined["paged_serving"] = _paged_serving_record(small)
+    # speculative sub-record (docs/speculative_decoding.md): draft +
+    # verify-pass decode A/B at batch 1 / full slots for k∈{0,2,4} with
+    # f32 and int8 drafts, and the chunked-prefill TTFT p50/p99 A/B
+    combined["speculative"] = _speculative_record(small)
     # quantization sub-record (docs/quantization.md): int8 weight-only
     # decode A/B at batch 1/8 + parked HBM weight bytes, and the same
     # flagship train step with fp8 delayed-scaling matmuls — defaults

@@ -526,7 +526,7 @@ class PSServer(_Node):
         # keep our liveness fresh at the scheduler; without this the
         # GetDeadNodes analog would flag healthy servers once a job
         # outlives the staleness timeout
-        # tp-lint: disable=race-unlocked-shared-state -- rebound exactly once, before the Thread.start() on the next line publishes it; start() is the happens-before edge
+        # tp-lint: disable=race-unlocked-shared-state -- rebound before Thread.start() publishes
         self._hb_stop = threading.Event()
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
 

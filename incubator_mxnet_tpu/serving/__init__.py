@@ -1,16 +1,20 @@
 """Serving subsystem: bucketed dynamic batching (:mod:`.engine`),
-KV-cache continuous-batching generation (:mod:`.generate`), and the
-paged KV cache with prefix caching (:mod:`.paged`).
+KV-cache continuous-batching generation (:mod:`.generate`), the paged
+KV cache with prefix caching (:mod:`.paged`), and speculative decoding
+with chunked prefill (:mod:`.speculative`).
 
-See docs/serving.md and docs/paged_kv.md for the architecture and knob
-tables."""
+See docs/serving.md, docs/paged_kv.md and docs/speculative_decoding.md
+for the architecture and knob tables."""
 from .engine import InferenceEngine, bucket_batch, bucket_length
 from .generate import (GenerationEngine, GenerationResult,
                        KVTransformerLM, LMSpec)
 from .paged import (BlockPool, PagedGenerationEngine, PagedKVCache,
                     prefix_hashes)
+from .speculative import (DraftModel, PagedSpeculativeGenerationEngine,
+                          SpeculativeGenerationEngine)
 
 __all__ = ["InferenceEngine", "GenerationEngine", "GenerationResult",
            "KVTransformerLM", "LMSpec", "BlockPool", "PagedKVCache",
            "PagedGenerationEngine", "prefix_hashes", "bucket_batch",
-           "bucket_length"]
+           "bucket_length", "DraftModel", "SpeculativeGenerationEngine",
+           "PagedSpeculativeGenerationEngine"]

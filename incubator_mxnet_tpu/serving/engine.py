@@ -88,6 +88,18 @@ class ServeStats:
         self.rows = 0
         self.padded_rows = 0
         self.compile_keys = set()
+        # speculative-decoding / chunked-prefill mirrors (filled by
+        # serving.speculative; stay 0 on plain engines)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_runs = 0
+        self.prefill_chunks = 0
+
+    @property
+    def spec_accept_rate(self) -> float:
+        """Fraction of proposed draft tokens the target accepted."""
+        return (self.spec_accepted / self.spec_proposed
+                if self.spec_proposed else 0.0)
 
     @property
     def num_compiles(self) -> int:

@@ -201,6 +201,7 @@ class KVTransformerLM:
         self.stats = ServeStats()
         self._prefill_fns = {}
         self._decode_fn = None
+        self._verify_fns = {}
         self._sample_fns = {}
 
     # ----------------------------------------------------------- cache setup
@@ -409,6 +410,95 @@ class KVTransformerLM:
         return self._decode_fn(cache_k, cache_v,
                                jnp.array(tokens, jnp.int32),
                                jnp.array(lengths, jnp.int32))
+
+    # ---------------------------------------------------------------- verify
+    def _build_verify(self):
+        import jax
+        import jax.numpy as jnp
+
+        s = self.spec
+        scale = 1.0 / s.head_dim ** 0.5
+        neg = jnp.finfo(jnp.float32).min
+
+        def verify(cache_k, cache_v, tokens, lengths, slots):
+            # tokens (N, M) int32: M candidate continuation tokens per
+            # row starting at cache position `lengths`; lengths/slots
+            # (N,) int32.  Each candidate attends the cached prefix
+            # (masked by `lengths`, like decode) plus the candidates at
+            # or before it (causal among the M) — ONE softmax over the
+            # concat, so the masked lanes underflow to exactly 0 and
+            # each row matches the sequential decode step bit-for-bit
+            # (same argument as the paged suffix prefill).
+            N, M = tokens.shape
+            S = cache_k.shape[3]
+            positions = lengths[:, None] + jnp.arange(M)[None, :]
+            x = self._embed(tokens,
+                            jnp.minimum(positions, s.max_seq - 1))
+            cmask = (jnp.arange(S)[None, :]
+                     < lengths[:, None])[:, None, None, :]  # (N,1,1,S)
+            causal = (jnp.arange(M)[:, None]
+                      >= jnp.arange(M)[None, :])            # (M, M)
+            ks, vs = [], []
+            for i in range(s.num_layers):
+                h = _ln(x, self.params["block%d_ln1_gamma" % i],
+                        self.params["block%d_ln1_beta" % i])
+                q, k, v = self._qkv(i, h)          # (N, M, H, D)
+                qh = jnp.moveaxis(q, 1, 2)         # (N, H, M, D)
+                kh = jnp.moveaxis(k, 1, 2)
+                vh = jnp.moveaxis(v, 1, 2)
+                # reads upcast (bf16 KV accumulates in f32, see decode)
+                kc = cache_k[slots, i].astype(jnp.float32)  # (N,H,S,D)
+                vc = cache_v[slots, i].astype(jnp.float32)
+                spre = jnp.einsum("nhqd,nhkd->nhqk", qh, kc) * scale
+                spre = jnp.where(cmask, spre, neg)
+                sself = jnp.einsum("nhqd,nhkd->nhqk", qh, kh) * scale
+                sself = jnp.where(causal, sself, neg)
+                w = jax.nn.softmax(
+                    jnp.concatenate([spre, sself], axis=-1), axis=-1)
+                att = jnp.einsum("nhqk,nhkd->nhqd", w[..., :S], vc) \
+                    + jnp.einsum("nhqk,nhkd->nhqd", w[..., S:], vh)
+                att = jnp.moveaxis(att, 1, 2)      # (N, M, H, D)
+                x = self._attn_out(i, att, x)
+                x = self._ffn(i, x)
+                ks.append(k)
+                vs.append(v)
+            # scatter ALL M candidate K/V rows: acceptance is decided on
+            # the host AFTER this pass, and rollback is free — the mask
+            # is `position < length`, so rejected positions are simply
+            # never attended and get overwritten by later writes
+            knew = jnp.stack(ks, axis=2)     # (N, M, layers, H, D)
+            vnew = jnp.stack(vs, axis=2)
+            pos = jnp.minimum(positions, S - 1)          # (N, M)
+            cache_k = cache_k.at[slots[:, None], :, :, pos, :].set(
+                knew.astype(cache_k.dtype))
+            cache_v = cache_v.at[slots[:, None], :, :, pos, :].set(
+                vnew.astype(cache_v.dtype))
+            x = _ln(x, self.params["ln_f_gamma"],
+                    self.params["ln_f_beta"])
+            return cache_k, cache_v, self._head(x)   # logits (N, M, V)
+
+        return verify
+
+    def verify(self, cache_k, cache_v, tokens: np.ndarray,
+               lengths: np.ndarray, slots: np.ndarray):
+        """Score M candidate positions per slot in ONE compiled pass
+        (the speculative-decoding verify step; also the rectangular
+        chunked-prefill continuation).  ``tokens`` (N, M); returns
+        (cache_k, cache_v, logits (N, M, vocab))."""
+        import jax
+        import jax.numpy as jnp
+
+        N, M = tokens.shape
+        fn = self._verify_fns.get((N, M))
+        if fn is None:
+            fn = jax.jit(self._build_verify())
+            self._verify_fns[(N, M)] = fn
+        self.stats.record_batch(("verify", N, M), N, N, "verify")
+        # forced copy: see prefill() — callers mutate lengths in place
+        return fn(cache_k, cache_v,
+                  jnp.array(tokens, jnp.int32),
+                  jnp.array(lengths, jnp.int32),
+                  jnp.array(slots, jnp.int32))
 
     # --------------------------------------------------------------- oracles
     def full_logits(self, tokens: np.ndarray) -> np.ndarray:
@@ -629,13 +719,25 @@ class GenerationEngine:
         self._cache_k, self._cache_v = self.model.init_cache(
             self.max_slots, self.max_len)
 
+    def _spec_reserve_extra(self) -> int:
+        """Cache positions a request may transiently need beyond
+        ``prompt + max_new`` (hook: the speculative engine returns k —
+        a verify pass writes k candidate K/V rows past the accepted
+        length, and the reservation must cover the worst case so no
+        mid-speculation allocation can fail)."""
+        return 0
+
     def _check_request(self, tokens: np.ndarray, max_new: int) -> None:
         """Reject a request that could NEVER be admitted (hook: the
         paged engine adds a page-budget bound)."""
-        if tokens.size + max_new > self.max_len:
+        extra = self._spec_reserve_extra()
+        if tokens.size + max_new + extra > self.max_len:
             raise MXNetError(
-                "prompt (%d) + max_new_tokens (%d) exceeds the engine's "
-                "max_len (%d)" % (tokens.size, max_new, self.max_len))
+                "prompt (%d) + max_new_tokens (%d)%s exceeds the "
+                "engine's max_len (%d)"
+                % (tokens.size, max_new,
+                   " + speculative headroom (%d)" % extra if extra
+                   else "", self.max_len))
 
     # ------------------------------------------------------------ client API
     def submit(self, tokens, max_new_tokens: int = 16, *,
@@ -766,6 +868,11 @@ class GenerationEngine:
                 self._release(i)
                 seq.req.future.set_exception(exc)
 
+    def _abort_admission(self, req: _GenPending) -> None:
+        """Drop any reservation made for a request at admission time
+        that will never be seated in a slot (hook: the paged engine
+        returns the request's reserved KV pages to the pool)."""
+
     def _release(self, slot: int) -> None:
         """Free a slot (hook: the paged engine also returns its KV
         pages to the pool).  Zeroing the mask length is the stale-KV
@@ -811,7 +918,7 @@ class GenerationEngine:
                 now = time.monotonic()
                 for j, r in enumerate(chunk):
                     seq = _Seq(r, free[j], r.tokens.size)
-                    # tp-lint: disable=race-unlocked-shared-state -- the slot table is loop-thread-owned after construction; the cross-thread active_slots scan is an advisory monitoring read of GIL-atomic list cells
+                    # tp-lint: disable=race-unlocked-shared-state -- loop-owned; advisory scan
                     self._seqs[free[j]] = seq
                     self._lengths[free[j]] = r.tokens.size
                     self._emit(seq, logits[j], now)
@@ -824,21 +931,43 @@ class GenerationEngine:
         tok = int(self.model.sample(
             logits_row[None], self._next_key(),
             temperature=seq.req.temperature, top_k=seq.req.top_k)[0])
-        seq.generated.append(tok)
-        seq.last_token = tok
-        if seq.req.return_logits:
-            seq.logits.append(logits_row.copy())
-        telemetry.counter("serve_tokens_total").inc()
-        if seq.t_first is None:
-            seq.t_first = now
-            telemetry.histogram("serve_ttft_seconds").observe(
-                now - seq.req.t_submit)
-        else:
-            telemetry.histogram("serve_token_seconds").observe(
-                now - seq.t_last)
-        seq.t_last = now
-        if seq.done:
+        self._emit_run(seq, [tok], [logits_row], now)
+
+    def _emit_run(self, seq: _Seq, toks, logits_rows,
+                  now: float, finish: bool = True) -> int:
+        """Append a run of already-sampled tokens to ``seq`` —
+        truncating at ``max_new`` and after a stop token, so a
+        speculative accepted run retires in one tick with the same
+        stop semantics as token-by-token decode.  Latency histograms
+        observe once per run (a run IS one model step).  Returns the
+        number of tokens kept; with ``finish=False`` the caller
+        retires the sequence itself after updating cache lengths."""
+        kept = 0
+        for j, tok in enumerate(toks):
+            if len(seq.generated) >= seq.req.max_new:
+                break
+            tok = int(tok)
+            seq.generated.append(tok)
+            seq.last_token = tok
+            if seq.req.return_logits:
+                seq.logits.append(np.asarray(logits_rows[j]).copy())
+            kept += 1
+            if (seq.req.stop_token is not None
+                    and tok == seq.req.stop_token):
+                break
+        if kept:
+            telemetry.counter("serve_tokens_total").inc(kept)
+            if seq.t_first is None:
+                seq.t_first = now
+                telemetry.histogram("serve_ttft_seconds").observe(
+                    now - seq.req.t_submit)
+            else:
+                telemetry.histogram("serve_token_seconds").observe(
+                    now - seq.t_last)
+            seq.t_last = now
+        if finish and seq.done:
             self._finish(seq)
+        return kept
 
     def _finish(self, seq: _Seq) -> None:
         res = GenerationResult(

@@ -328,15 +328,23 @@ class PagedKVCache:
         self._shared_n: Dict[int, int] = {}
         self._prefill_fns = {}
         self._decode_fn = None
+        self._verify_fns = {}
 
     # --------------------------------------------------------- slot lifecycle
-    def pages_needed(self, prompt_len: int, max_new: int) -> int:
+    def pages_needed(self, prompt_len: int, max_new: int,
+                     extra: int = 0) -> int:
         """Worst-case page budget of one request: every position the
-        sequence can ever write, rounded up to whole pages."""
-        return -(-(int(prompt_len) + int(max_new)) // self.page_tokens)
+        sequence can ever write, rounded up to whole pages.  ``extra``
+        covers positions written only transiently — the speculative
+        verify pass scatters k candidate K/V rows past the accepted
+        length, and reserving them up front is what makes rollback
+        free (no mid-speculation allocation, so no mid-speculation
+        failure and no page leak)."""
+        return -(-(int(prompt_len) + int(max_new) + int(extra))
+                 // self.page_tokens)
 
-    def try_admit(self, slot: int, tokens, max_new: int
-                  ) -> Optional[int]:
+    def try_admit(self, slot: int, tokens, max_new: int,
+                  extra: int = 0) -> Optional[int]:
         """Reserve the request's whole worst-case page budget on slot
         ``slot``, reusing cached prefix pages by content hash.  Returns
         the shared-prefix token count, or None (reserving nothing) when
@@ -345,7 +353,7 @@ class PagedKVCache:
         decode can never stall on allocation mid-flight."""
         toks = np.asarray(tokens, np.int32).reshape(-1)
         P = self.page_tokens
-        total = self.pages_needed(toks.size, max_new)
+        total = self.pages_needed(toks.size, max_new, extra)
         if total > self.max_pages:
             raise MXNetError(
                 "request needs %d pages > max_pages %d"
@@ -382,11 +390,17 @@ class PagedKVCache:
         if blocks:
             self.pool.release(blocks)
 
-    def register_prompt(self, slot: int, tokens) -> None:
+    def register_prompt(self, slot: int, tokens,
+                        upto: Optional[int] = None) -> None:
         """Content-address the slot's freshly prefilled FULL prompt
         pages (past any shared prefix) so later prompts can skip them.
-        Call only after the prefill that filled them has been issued."""
+        Call only after the prefill that filled them has been issued.
+        ``upto`` limits registration to the first ``upto`` tokens —
+        chunked prefill registers chunk-at-a-time as pages complete
+        (``register`` is idempotent, so re-registering is safe)."""
         toks = np.asarray(tokens, np.int32).reshape(-1)
+        if upto is not None:
+            toks = toks[:int(upto)]
         digests = prefix_hashes(toks, self.page_tokens)
         row = self.tables[slot]
         for g in range(self._shared_n.get(slot, 0), len(digests)):
@@ -632,6 +646,112 @@ class PagedKVCache:
             jnp.array(self.tables, jnp.int32))
         return logits
 
+    def _build_verify(self, M: int):
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+        s = model.spec
+        P = self.page_tokens
+        S = self.max_pages * P
+        scale = 1.0 / s.head_dim ** 0.5
+        neg = jnp.finfo(jnp.float32).min
+
+        def verify(cache_k, cache_v, tokens, lengths, tables):
+            # tokens (slots, M): M candidate continuations per slot at
+            # positions `lengths .. lengths+M-1`; same gathered
+            # rectangular view as paged decode, causal among the M,
+            # ONE softmax over [cached | candidates] so greedy rows
+            # match sequential paged decode bit-for-bit (the masked-
+            # lanes-underflow-to-0 argument of the suffix prefill)
+            nslots = tokens.shape[0]
+            positions = lengths[:, None] + jnp.arange(M)[None, :]
+            x = model._embed(tokens,
+                             jnp.minimum(positions, s.max_seq - 1))
+            cmask = (jnp.arange(S)[None, :]
+                     < lengths[:, None])[:, None, None, :]
+            causal = (jnp.arange(M)[:, None]
+                      >= jnp.arange(M)[None, :])
+            gk = jnp.reshape(jnp.moveaxis(cache_k[tables], 1, 3),
+                             (nslots, s.num_layers, s.heads, S,
+                              s.head_dim))
+            gv = jnp.reshape(jnp.moveaxis(cache_v[tables], 1, 3),
+                             (nslots, s.num_layers, s.heads, S,
+                              s.head_dim))
+            ks, vs = [], []
+            for i in range(s.num_layers):
+                h = _ln(x, model.params["block%d_ln1_gamma" % i],
+                        model.params["block%d_ln1_beta" % i])
+                q, k, v = model._qkv(i, h)       # (slots, M, H, D)
+                qh = jnp.moveaxis(q, 1, 2)       # (slots, H, M, D)
+                kh = jnp.moveaxis(k, 1, 2)
+                vh = jnp.moveaxis(v, 1, 2)
+                kc = gk[:, i].astype(jnp.float32)
+                vc = gv[:, i].astype(jnp.float32)
+                spre = jnp.einsum("nhqd,nhkd->nhqk", qh, kc) * scale
+                spre = jnp.where(cmask, spre, neg)
+                sself = jnp.einsum("nhqd,nhkd->nhqk", qh, kh) * scale
+                sself = jnp.where(causal, sself, neg)
+                w = jax.nn.softmax(
+                    jnp.concatenate([spre, sself], axis=-1), axis=-1)
+                att = jnp.einsum("nhqk,nhkd->nhqd", w[..., :S], vc) \
+                    + jnp.einsum("nhqk,nhkd->nhqd", w[..., S:], vh)
+                att = jnp.moveaxis(att, 1, 2)    # (slots, M, H, D)
+                x = model._attn_out(i, att, x)
+                x = model._ffn(i, x)
+                ks.append(k)
+                vs.append(v)
+            # scatter all M candidate rows through the page table —
+            # rejected positions sit past `length` afterwards, so they
+            # are unreachable (mask) and overwritten by later writes;
+            # the reservation's `extra` headroom guarantees the target
+            # pages are owned, so no page of another slot is touched
+            knew = jnp.stack(ks, axis=2)     # (slots, M, layers, H, D)
+            vnew = jnp.stack(vs, axis=2)
+            pos = jnp.minimum(positions, S - 1)          # (slots, M)
+            blk = jnp.take_along_axis(tables, pos // P, axis=1)
+            off = pos % P
+            cache_k = cache_k.at[blk, :, :, off, :].set(
+                knew.astype(cache_k.dtype))
+            cache_v = cache_v.at[blk, :, :, off, :].set(
+                vnew.astype(cache_v.dtype))
+            x = _ln(x, model.params["ln_f_gamma"],
+                    model.params["ln_f_beta"])
+            return cache_k, cache_v, model._head(x)
+
+        return verify
+
+    def verify(self, tokens: np.ndarray, lengths: np.ndarray,
+               active: Optional[np.ndarray] = None):
+        """Score M candidate positions per slot in ONE compiled pass
+        (the paged speculative verify).  ``tokens`` (slots, M);
+        ``active`` masks rows whose slots should not be written (their
+        gather/scatter pages are redirected to scratch — a slot mid-
+        chunked-prefill must not have candidate garbage scattered into
+        pages its next chunk will fill).  Mutates the cache in place;
+        returns (slots, M, vocab) logits."""
+        import jax
+        import jax.numpy as jnp
+
+        n, M = np.asarray(tokens).shape
+        fn = self._verify_fns.get((n, M))
+        if fn is None:
+            fn = jax.jit(self._build_verify(M))
+            self._verify_fns[(n, M)] = fn
+        nact = n if active is None else int(np.asarray(active).sum())
+        self.model.stats.record_batch(("paged_verify", n, M), nact, n,
+                                      "verify")
+        tables = self.tables
+        if active is not None:
+            tables = np.where(np.asarray(active, bool)[:, None],
+                              self.tables, np.int32(self.scratch))
+        self.cache_k, self.cache_v, logits = fn(
+            self.cache_k, self.cache_v,
+            jnp.array(tokens, jnp.int32),
+            jnp.array(lengths, jnp.int32),
+            jnp.array(tables, jnp.int32))
+        return logits
+
 
 class PagedGenerationEngine(GenerationEngine):
     """:class:`GenerationEngine` over a :class:`PagedKVCache`: same
@@ -673,7 +793,8 @@ class PagedGenerationEngine(GenerationEngine):
     # ---------------------------------------------------------- admission
     def _check_request(self, tokens: np.ndarray, max_new: int) -> None:
         super()._check_request(tokens, max_new)
-        need = self._kv.pages_needed(tokens.size, max_new)
+        need = self._kv.pages_needed(tokens.size, max_new,
+                                     self._spec_reserve_extra())
         if need > self._kv.num_blocks:
             raise MXNetError(
                 "request needs %d KV pages but the pool holds only %d "
@@ -692,7 +813,8 @@ class PagedGenerationEngine(GenerationEngine):
             if rest or not free:
                 rest.append(p)
                 continue
-            shared = self._kv.try_admit(free[0], p.tokens, p.max_new)
+            shared = self._kv.try_admit(free[0], p.tokens, p.max_new,
+                                        extra=self._spec_reserve_extra())
             if shared is None:
                 rest.append(p)
                 continue
@@ -713,7 +835,7 @@ class PagedGenerationEngine(GenerationEngine):
         live: List[_GenPending] = []
         for r in reqs:
             if r.deadline is not None and now > r.deadline:
-                self._kv.release_slot(r.slot)
+                self._abort_admission(r)
                 self.stats.expired += 1
                 telemetry.counter("serve_deadline_expired_total").inc()
                 r.future.set_exception(MXNetError(
@@ -759,6 +881,12 @@ class PagedGenerationEngine(GenerationEngine):
                     # list
                     self._kv.register_prompt(r.slot, r.tokens)
                     self._emit(seq, logits[j], now)
+
+    def _abort_admission(self, req: _GenPending) -> None:
+        """Return the pages :meth:`PagedKVCache.try_admit` reserved for
+        a request that will never be seated."""
+        if req.slot is not None:
+            self._kv.release_slot(req.slot)
 
     # ------------------------------------------------------------- decode
     def _decode_batch(self, tokens: np.ndarray) -> np.ndarray:

@@ -1,0 +1,639 @@
+"""Speculative decoding + chunked prefill: compute-side decode latency
+for the serving engine.
+
+Paged KV (``paged.py``) solved serving *memory*; this module attacks
+the *compute* side of decode latency with two composable mechanisms
+that bolt onto the existing continuous-batching loop:
+
+- **Speculative decoding** (Leviathan et al., 2023).  A small
+  :class:`DraftModel` proposes ``k`` greedy tokens per slot per tick
+  (k+1 launches of ITS one-compiled-decode, nearly free next to the
+  target), then the target scores all ``k+1`` candidate positions in
+  ONE compiled ``verify`` pass — the prefill attention math over the
+  cached prefix plus a causal block among the candidates, one softmax
+  per row, so each verify row equals the sequential decode step
+  bit-for-bit.  The standard rejection rule accepts the longest
+  matching prefix plus one correction/bonus token, so every tick
+  retires between 1 and k+1 tokens per slot and **greedy output is
+  bit-exact** to the non-speculative engine (``tests/
+  test_speculative.py`` asserts it on both cache layouts).  Rollback
+  past the first rejection is free by the mask invariant: rejected
+  K/V rows sit at positions ``>= length`` — unreachable (the
+  attention mask is ``position < length``) and overwritten by later
+  writes.  On the paged cache the admission reservation is k-aware
+  (``pages_needed(..., extra=k)``) so verify writes always land in
+  pages the slot already owns: no mid-speculation allocation, no page
+  leaks.
+
+- **Chunked prefill** (Sarathi-Serve).  Long prompts are admitted as
+  usual (slot + full worst-case page reservation) but prefilled in
+  ``TP_SERVE_PREFILL_CHUNK``-token chunks, ONE chunk per engine tick,
+  interleaved with decode — running slots no longer stall for a whole
+  long-prompt prefill, which is what bounds decode tail latency and
+  TTFT p99 under long-prompt traffic.  The rectangular engine feeds
+  chunks through the same ``verify`` continuation program; the paged
+  engine reuses its suffix-prefill buckets (chunk sizes round up to a
+  page multiple so the whole-page scatter stays aligned), registering
+  prefix pages chunk-at-a-time.  A slot mid-prefill is excluded from
+  the decode batch (its verify/decode writes are routed to scratch)
+  until its final chunk emits the first token.
+
+Knobs: ``TP_SERVE_SPEC_K`` (0 = off), ``TP_SERVE_SPEC_DRAFT``
+(checkpoint prefix for the draft), ``TP_SERVE_PREFILL_CHUNK`` (0 =
+off), ``TP_SERVE_SPEC_DYNAMIC`` (1 = halve k when the batch is full —
+speculation trades FLOPs for latency, and a full batch is already
+compute-bound).  Telemetry: ``serve_spec_proposed_total`` /
+``serve_spec_accepted_total`` / ``serve_spec_accept_rate`` /
+``serve_prefill_chunks_total``.  See docs/speculative_decoding.md for
+the verify math, the rejection rule, and the rollback/page contract.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..analysis.race_checker import race_audit
+from ..base import MXNetError, get_env
+from .engine import bucket_batch, bucket_length
+from .generate import GenerationEngine, KVTransformerLM, _GenPending, \
+    _Seq
+from .paged import PagedGenerationEngine
+
+__all__ = ["DraftModel", "SpeculativeGenerationEngine",
+           "PagedSpeculativeGenerationEngine"]
+
+
+class DraftModel:
+    """A small :class:`KVTransformerLM` proposing greedy candidate
+    tokens, its rectangular KV cache kept in lockstep with the target
+    engine's slots.
+
+    The draft always uses the rectangular layout even under the paged
+    target engine — it is small by construction (that is the point),
+    so its worst-case rectangle is cheap, lockstep is a single
+    ``lengths`` array, and there is no second block pool that could
+    exhaust mid-flight.  ``model=None`` builds a shell for test
+    doubles that override :meth:`propose`.
+    """
+
+    def __init__(self, model: Optional[KVTransformerLM]):
+        self.model = model
+        self.cache_k = None
+        self.cache_v = None
+        # per-slot cached-token counts, maintained by the engine in
+        # lockstep with its own `_lengths` (loop-thread-owned)
+        self.lengths: Optional[np.ndarray] = None
+        self.max_slots = 0
+
+    @classmethod
+    def from_env(cls, target: KVTransformerLM) -> "DraftModel":
+        """Load the draft checkpoint named by ``TP_SERVE_SPEC_DRAFT``
+        (a ``save_checkpoint`` prefix, epoch 0).  Heads default to the
+        target's (``TP_SERVE_SPEC_DRAFT_HEADS`` overrides); weight
+        dtype follows ``TP_SERVE_SPEC_DRAFT_DTYPE`` (empty inherits
+        ``TP_SERVE_WEIGHT_DTYPE``), so an int8 draft costs one env
+        var."""
+        prefix = get_env("SERVE_SPEC_DRAFT")
+        if not prefix:
+            raise MXNetError(
+                "speculative decoding needs a draft model: pass "
+                "draft= or set TP_SERVE_SPEC_DRAFT to a checkpoint "
+                "prefix")
+        from ..model import load_checkpoint
+
+        _sym, arg_params, _aux = load_checkpoint(prefix, 0)
+        heads = get_env("SERVE_SPEC_DRAFT_HEADS", 0, int) \
+            or target.spec.heads
+        dt = get_env("SERVE_SPEC_DRAFT_DTYPE") or None
+        return cls(KVTransformerLM(arg_params, heads, weight_dtype=dt))
+
+    def setup(self, max_slots: int, max_len: int) -> None:
+        """Allocate the lockstep cache: same slot count and position
+        budget as the target engine (+ the scratch slot)."""
+        self.max_slots = int(max_slots)
+        self.lengths = np.zeros(max_slots, np.int32)
+        if self.model is not None:
+            if max_len > self.model.spec.max_seq:
+                raise MXNetError(
+                    "draft position table (%d) is smaller than the "
+                    "engine max_len (%d)"
+                    % (self.model.spec.max_seq, max_len))
+            self.cache_k, self.cache_v = self.model.init_cache(
+                max_slots, max_len)
+
+    def prefill(self, tokens: np.ndarray, lens: np.ndarray,
+                slots: np.ndarray) -> None:
+        """Ingest prompt K/V for the given slots (bucketed like the
+        target's rectangular prefill; logits discarded)."""
+        if self.model is None:
+            return
+        self.cache_k, self.cache_v, _ = self.model.prefill(
+            self.cache_k, self.cache_v, tokens, lens, slots)
+
+    def propose(self, tokens: np.ndarray, k: int) -> np.ndarray:
+        """Greedily propose ``k`` tokens per slot: ``k + 1`` runs of
+        the draft's one-compiled-decode.  ``tokens`` (slots,) is each
+        slot's newest emitted token (no K/V yet, same convention as
+        the target loop).  The extra final step pre-ingests the last
+        proposal's K/V, which keeps the draft cache exactly one fed
+        token behind in EVERY outcome — including a full accept, where
+        the target's bonus token becomes the next tick's fed token and
+        the draft must already hold K/V for all k proposals."""
+        n = int(np.asarray(tokens).shape[0])
+        drafts = np.zeros((n, int(k)), np.int32)
+        if self.model is None:
+            return drafts
+        cur = np.array(tokens, np.int32)
+        lens = np.array(self.lengths, np.int32)
+        for j in range(int(k) + 1):
+            self.cache_k, self.cache_v, logits = self.model.decode(
+                self.cache_k, self.cache_v, cur, lens)
+            lens += 1
+            if j < k:
+                cur = np.argmax(np.asarray(logits),
+                                axis=-1).astype(np.int32)
+                drafts[:, j] = cur
+        return drafts
+
+
+class _ChunkState:
+    """Bookkeeping for one slot mid-chunked-prefill: progress lives in
+    ``seq.length`` (tokens of the prompt already cached)."""
+
+    __slots__ = ("req", "seq")
+
+    def __init__(self, req: _GenPending, seq: _Seq):
+        self.req = req
+        self.seq = seq
+
+
+class _SpecMixin:
+    """The speculative + chunked-prefill loop, cache-layout agnostic.
+
+    Subclasses bind it over :class:`GenerationEngine` (rectangular) or
+    :class:`PagedGenerationEngine` via four hooks: ``_verify_batch``
+    (one target pass over k+1 candidates), ``_chunk_prefill`` (one
+    prompt chunk for a batch of mid-prefill slots), ``_chunk_size``
+    (layout-legal chunk length) and ``_register_chunk`` (paged prefix
+    registration).  MUST be configured (``_spec_configure``) before
+    the base ``__init__`` runs — the base constructor starts the loop
+    thread."""
+
+    def _spec_configure(self, model: KVTransformerLM, *,
+                        draft=None, spec_k: Optional[int] = None,
+                        prefill_chunk: Optional[int] = None,
+                        dynamic_k: Optional[bool] = None,
+                        spec_seed: int = 0) -> None:
+        self.spec_k = int(spec_k if spec_k is not None
+                          else get_env("SERVE_SPEC_K", 0, int))
+        self.prefill_chunk = int(
+            prefill_chunk if prefill_chunk is not None
+            else get_env("SERVE_PREFILL_CHUNK", 0, int))
+        self.dynamic_k = bool(
+            dynamic_k if dynamic_k is not None
+            else get_env("SERVE_SPEC_DYNAMIC", 0, int))
+        if self.spec_k < 0 or self.prefill_chunk < 0:
+            raise MXNetError("spec_k and prefill_chunk must be >= 0")
+        if draft is not None and not isinstance(draft, DraftModel):
+            draft = DraftModel(draft)
+        if draft is None and self.spec_k > 0:
+            draft = DraftModel.from_env(model)
+        if draft is not None and draft.model is not None \
+                and draft.model.spec.vocab_size != model.spec.vocab_size:
+            raise MXNetError(
+                "draft vocab (%d) != target vocab (%d)"
+                % (draft.model.spec.vocab_size, model.spec.vocab_size))
+        self.draft = draft
+        # engine-local mirrors (mutated under self._cond, mirrored
+        # into model.stats under its own lock)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_runs = 0
+        self.prefill_chunks = 0
+        self._chunking: Dict[int, _ChunkState] = {}
+        self._spec_rng = np.random.default_rng(spec_seed)
+
+    # ------------------------------------------------------------ plumbing
+    def _setup_cache(self) -> None:
+        super()._setup_cache()
+        if self.draft is not None:
+            self.draft.setup(self.max_slots, self.max_len)
+
+    def _spec_reserve_extra(self) -> int:
+        # verify scatters k candidate K/V rows past the accepted
+        # length — reserve them up front so rollback is free
+        return self.spec_k if (self.draft is not None
+                               and self.spec_k > 0) else 0
+
+    def _release(self, slot: int) -> None:
+        self._chunking.pop(slot, None)
+        if self.draft is not None:
+            self.draft.lengths[slot] = 0
+        super()._release(slot)
+
+    def _effective_k(self, n_active: int) -> int:
+        """Dynamic-k: a full batch is already compute-bound, so spend
+        fewer speculative FLOPs on it (halve k, floor 1)."""
+        k = self.spec_k
+        if self.dynamic_k and k > 1 and n_active >= self.max_slots:
+            k = max(1, k // 2)
+        return k
+
+    # ----------------------------------------------------------- admission
+    def _chunk_size(self) -> int:
+        """Prompt tokens prefilled per tick (0 = chunking off; the
+        paged engine rounds up to a page multiple)."""
+        return self.prefill_chunk
+
+    def _admit(self, reqs: List[_GenPending]) -> None:
+        chunk = self._chunk_size()
+        direct: List[_GenPending] = []
+        chunked: List[_GenPending] = []
+        for r in reqs:
+            if chunk and r.tokens.size - r.shared_tokens > chunk:
+                chunked.append(r)
+            else:
+                direct.append(r)
+        if direct:
+            super()._admit(direct)
+            self._draft_ingest(direct)
+        for r in chunked:
+            self._seat_chunked(r)
+
+    def _seat_chunked(self, r: _GenPending) -> None:
+        """Seat a long-prompt request WITHOUT prefilling it: the slot
+        holds only its shared prefix so far; ``_advance_chunks`` feeds
+        one chunk per tick until the final chunk emits the first
+        token.  ``last_token`` stays None meanwhile, which excludes
+        the slot from the decode batch."""
+        now = time.monotonic()
+        if r.deadline is not None and now > r.deadline:
+            self._abort_admission(r)
+            with self.stats.lock:
+                self.stats.expired += 1
+            telemetry.counter("serve_deadline_expired_total").inc()
+            r.future.set_exception(MXNetError(
+                "request deadline expired after %.1f ms in queue"
+                % ((now - r.t_submit) * 1e3)))
+            return
+        slot = r.slot
+        if slot is None:  # rectangular path: no up-front reservation
+            slot = next(i for i, s in enumerate(self._seqs)
+                        if s is None)
+            r.slot = slot
+        seq = _Seq(r, slot, r.tokens.size)
+        seq.length = r.shared_tokens
+        self._seqs[slot] = seq
+        self._lengths[slot] = r.shared_tokens
+        self._chunking[slot] = _ChunkState(r, seq)
+        # the draft ingests the WHOLE prompt up front: chunking exists
+        # to bound the TARGET's per-tick prefill compute, and the
+        # draft is small by construction
+        self._draft_ingest([r])
+
+    def _draft_ingest(self, reqs: List[_GenPending]) -> None:
+        """Prefill the draft cache with the full prompts of freshly
+        seated requests (bucketed like the rectangular prefill).
+        Requests that already finished inside ``_admit`` (1-token
+        answers) have released their slot — nothing to ingest."""
+        if self.draft is None or self.draft.model is None or not reqs:
+            return
+        byreq = {id(s.req): s for s in self._seqs if s is not None}
+        seated = [(r, byreq[id(r)]) for r in reqs if id(r) in byreq]
+        groups: Dict[int, List] = {}
+        for r, seq in seated:
+            L = bucket_length(r.tokens.size, self.max_len)
+            groups.setdefault(L, []).append((r, seq))
+        for L, group in sorted(groups.items()):
+            for start in range(0, len(group), self.max_slots):
+                part = group[start:start + self.max_slots]
+                nb = bucket_batch(len(part), self.max_slots)
+                toks = np.zeros((nb, L), np.int32)
+                lens = np.ones(nb, np.int32)
+                slots = np.full(nb, self.max_slots, np.int32)
+                for j, (r, seq) in enumerate(part):
+                    toks[j, :r.tokens.size] = r.tokens
+                    lens[j] = r.tokens.size
+                    slots[j] = seq.slot
+                self.draft.prefill(toks, lens, slots)
+                for r, seq in part:
+                    self.draft.lengths[seq.slot] = r.tokens.size
+
+    # ---------------------------------------------------------- chunk ticks
+    def _advance_chunks(self) -> None:
+        """Feed ONE prompt chunk to every mid-prefill slot (batched at
+        a single length bucket) — the interleaving that keeps decode
+        ticks flowing between chunks."""
+        if not self._chunking:
+            return
+        now = time.monotonic()
+        for slot in list(self._chunking):
+            st = self._chunking[slot]
+            if st.req.deadline is not None and now > st.req.deadline:
+                self._release(slot)  # pops the chunk state too
+                with self.stats.lock:
+                    self.stats.expired += 1
+                telemetry.counter("serve_deadline_expired_total").inc()
+                st.req.future.set_exception(MXNetError(
+                    "request deadline expired after %.1f ms mid-"
+                    "prefill" % ((now - st.req.t_submit) * 1e3)))
+        if not self._chunking:
+            return
+        chunk = self._chunk_size()
+        items = sorted(self._chunking.items())
+        n = len(items)
+        takes = np.ones(n, np.int32)
+        for j, (slot, st) in enumerate(items):
+            takes[j] = min(chunk, st.req.tokens.size - st.seq.length)
+        L = bucket_length(int(takes.max()), self.max_len)
+        nb = bucket_batch(n, self.max_slots)
+        toks = np.zeros((nb, L), np.int32)
+        starts = np.zeros(nb, np.int32)
+        tk = np.ones(nb, np.int32)
+        slots = np.full(nb, -1, np.int32)
+        for j, (slot, st) in enumerate(items):
+            lo = st.seq.length
+            toks[j, :takes[j]] = st.req.tokens[lo:lo + takes[j]]
+            starts[j] = lo
+            tk[j] = takes[j]
+            slots[j] = slot
+        npref = int(takes.sum())
+        with self._cond:
+            self.prefill_tokens += npref
+            self.prefill_chunks += n
+        with self.stats.lock:
+            self.stats.prefill_chunks += n
+        telemetry.counter("serve_prefill_tokens_total").inc(npref)
+        telemetry.counter("serve_prefill_chunks_total").inc(n)
+        logits = self._chunk_prefill(toks, starts, tk, slots)
+        now = time.monotonic()
+        for j, (slot, st) in enumerate(items):
+            st.seq.length += int(takes[j])
+            self._lengths[slot] = st.seq.length
+            self._register_chunk(st)
+            if st.seq.length >= st.req.tokens.size:
+                # final chunk: TTFT ends here — sample the first token
+                # through the same path as a direct admission
+                del self._chunking[slot]
+                self._emit(st.seq, logits[j], now)
+
+    def _chunk_prefill(self, toks: np.ndarray, starts: np.ndarray,
+                       takes: np.ndarray, slots: np.ndarray
+                       ) -> np.ndarray:
+        """Run one chunk bucket; returns per-row logits at each row's
+        final chunk position.  Rectangular: the ``verify`` program IS
+        the continuation prefill (all-position logits; take the
+        last real one)."""
+        rows = np.where(slots >= 0, slots,
+                        self.max_slots).astype(np.int32)
+        lens = np.zeros(rows.shape[0], np.int32)
+        lens[slots >= 0] = starts[slots >= 0]
+        self._cache_k, self._cache_v, logits = self.model.verify(
+            self._cache_k, self._cache_v, toks, lens, rows)
+        logits = np.asarray(logits)
+        return logits[np.arange(rows.shape[0]), takes - 1]
+
+    def _register_chunk(self, st: _ChunkState) -> None:
+        """Hook: the paged engine content-addresses completed prompt
+        pages chunk-at-a-time."""
+
+    # ---------------------------------------------------------- decode tick
+    def _decode_step(self) -> None:
+        self._advance_chunks()
+        active = [s for s in self._seqs
+                  if s is not None and s.last_token is not None]
+        if not active:
+            return
+        use_spec = self.draft is not None and self.spec_k > 0
+        k = self._effective_k(len(active)) if use_spec else 0
+        if k <= 0:
+            self._plain_tick(active)
+        else:
+            self._spec_tick(active, k)
+
+    def _plain_tick(self, active: List[_Seq]) -> None:
+        """The base single-token decode over the ACTIVE slots only
+        (mid-prefill slots are excluded; their table rows still feed
+        the program but their writes land at positions their next
+        chunk overwrites)."""
+        tokens = np.zeros(self.max_slots, np.int32)
+        for seq in active:
+            tokens[seq.slot] = seq.last_token
+        with self._cond:
+            self.active_high_water = max(self.active_high_water,
+                                         len(active))
+        telemetry.histogram("serve_decode_active").observe(len(active))
+        logits = np.asarray(self._decode_batch(tokens))
+        now = time.monotonic()
+        for seq in active:
+            seq.length += 1
+            self._lengths[seq.slot] = seq.length
+            self._emit(seq, logits[seq.slot], now)
+            if (self._seqs[seq.slot] is seq
+                    and seq.req.deadline is not None
+                    and now > seq.req.deadline):
+                self._finish(seq)
+
+    def _spec_tick(self, active: List[_Seq], k: int) -> None:
+        """One speculative iteration: k draft proposals per slot, ONE
+        target verify pass over the k+1 candidates, longest-matching-
+        prefix acceptance, both caches rolled forward to the accepted
+        length (rollback = not advancing past it)."""
+        tokens = np.zeros(self.max_slots, np.int32)
+        amask = np.zeros(self.max_slots, bool)
+        for seq in active:
+            tokens[seq.slot] = seq.last_token
+            amask[seq.slot] = True
+        with self._cond:
+            self.active_high_water = max(self.active_high_water,
+                                         len(active))
+        telemetry.histogram("serve_decode_active").observe(len(active))
+        drafts = self.draft.propose(tokens, k)     # (slots, k)
+        cand = np.concatenate([tokens[:, None], drafts], axis=1)
+        logits = self._verify_batch(cand, amask)   # (slots, k+1, V)
+        now = time.monotonic()
+        proposed = accepted = 0
+        for seq in active:
+            i = seq.slot
+            toks, rows, matched = self._accept(seq, drafts[i],
+                                               logits[i])
+            proposed += k
+            accepted += matched
+            kept = self._emit_run(seq, toks, rows, now, finish=False)
+            # every kept token except the newest has K/V from the
+            # verify scatter; candidates past `kept` are now stale —
+            # unreachable through the mask, overwritten later
+            seq.length += kept
+            self._lengths[i] = seq.length
+            self.draft.lengths[i] = seq.length
+            if seq.done or (seq.req.deadline is not None
+                            and now > seq.req.deadline):
+                self._finish(seq)
+        with self._cond:
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
+            self.spec_runs += 1
+        with self.stats.lock:
+            self.stats.spec_proposed += proposed
+            self.stats.spec_accepted += accepted
+            self.stats.spec_runs += 1
+        telemetry.counter("serve_spec_proposed_total").inc(proposed)
+        telemetry.counter("serve_spec_accepted_total").inc(accepted)
+        telemetry.gauge("serve_spec_accept_rate").set(
+            self.spec_accepted / max(1, self.spec_proposed))
+
+    def _verify_batch(self, cand: np.ndarray,
+                      active: np.ndarray) -> np.ndarray:
+        """ONE target pass over (slots, k+1) candidates.  Rectangular:
+        inactive rows (free or mid-prefill slots) scatter to the
+        scratch slot so candidate garbage cannot touch real cache
+        rows a later chunk expects to own."""
+        rows = np.where(active, np.arange(self.max_slots),
+                        self.max_slots).astype(np.int32)
+        self._cache_k, self._cache_v, logits = self.model.verify(
+            self._cache_k, self._cache_v, cand, self._lengths, rows)
+        return np.asarray(logits)
+
+    # ----------------------------------------------------------- acceptance
+    def _accept(self, seq: _Seq, drafts: np.ndarray,
+                vlogits: np.ndarray):
+        """Apply the rejection rule to one slot's verify logits
+        (k+1, V).  Greedy: accept while the draft equals the target
+        argmax; the first mismatching position contributes the
+        target's own token (correction), a full match contributes the
+        bonus token — identical, token for token, to running the
+        sequential greedy decode.  Temperature: standard speculative
+        sampling with the greedy draft as a point-mass proposal:
+        accept d with prob p(d); on rejection resample from p with
+        d's mass removed; on full acceptance take a bonus sample.
+        Returns (tokens, logits_rows, matched_draft_count)."""
+        k = int(drafts.shape[0])
+        temp = seq.req.temperature
+        if temp <= 0.0:
+            t = np.argmax(vlogits, axis=-1)
+            a = 0
+            while a < k and int(t[a]) == int(drafts[a]):
+                a += 1
+            idx = list(range(a + 1))
+            return ([int(t[j]) for j in idx],
+                    [vlogits[j] for j in idx], a)
+        toks: List[int] = []
+        rows: List[np.ndarray] = []
+        matched = 0
+        for j in range(k):
+            p = self._target_probs(vlogits[j], temp, seq.req.top_k)
+            d = int(drafts[j])
+            rows.append(vlogits[j])
+            if self._spec_rng.random() < p[d]:
+                toks.append(d)
+                matched += 1
+                continue
+            q = p.copy()
+            q[d] = 0.0
+            s = q.sum()
+            if s <= 0.0:  # p was a point mass on d: keep it
+                toks.append(d)
+                matched += 1
+                continue
+            toks.append(int(self._spec_rng.choice(p.size, p=q / s)))
+            return toks, rows, matched
+        p = self._target_probs(vlogits[k], temp, seq.req.top_k)
+        toks.append(int(self._spec_rng.choice(p.size, p=p)))
+        rows.append(vlogits[k])
+        return toks, rows, matched
+
+    @staticmethod
+    def _target_probs(logits: np.ndarray, temperature: float,
+                      top_k: int) -> np.ndarray:
+        """Host replica of ``KVTransformerLM.sample``'s policy
+        (temperature scaling, optional top-k truncation, softmax).
+        The stochastic path draws from the engine's own RNG stream, so
+        it matches the non-speculative DISTRIBUTION, not its exact
+        sample sequence (greedy is the bit-exact mode)."""
+        x = np.asarray(logits, np.float64) / float(temperature)
+        if top_k:
+            kth = np.partition(x, -int(top_k))[-int(top_k)]
+            x = np.where(x < kth, -np.inf, x)
+        x = x - x.max()
+        p = np.exp(x)
+        return p / p.sum()
+
+
+@race_audit(exempt=("_seqs", "_lengths", "_cache_k", "_cache_v",
+                    "_key", "prefill_tokens", "active_high_water",
+                    "spec_proposed", "spec_accepted", "spec_runs",
+                    "prefill_chunks", "_chunking"))
+class SpeculativeGenerationEngine(_SpecMixin, GenerationEngine):
+    """:class:`GenerationEngine` (rectangular cache) with speculative
+    decoding and chunked prefill.  ``spec_k=0`` with a positive
+    ``prefill_chunk`` gives chunked prefill alone; greedy output is
+    bit-exact to the plain engine in every configuration."""
+
+    def __init__(self, model: KVTransformerLM, *, draft=None,
+                 spec_k: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 dynamic_k: Optional[bool] = None, **kw):
+        self._spec_configure(model, draft=draft, spec_k=spec_k,
+                             prefill_chunk=prefill_chunk,
+                             dynamic_k=dynamic_k,
+                             spec_seed=kw.get("seed", 0))
+        kw.setdefault("name", "serve_spec_lm")
+        super().__init__(model, **kw)
+
+
+@race_audit(exempt=("_seqs", "_lengths", "_cache_k", "_cache_v",
+                    "_key", "prefill_tokens", "active_high_water",
+                    "spec_proposed", "spec_accepted", "spec_runs",
+                    "prefill_chunks", "_chunking"))
+class PagedSpeculativeGenerationEngine(_SpecMixin,
+                                       PagedGenerationEngine):
+    """:class:`PagedGenerationEngine` with speculative decoding and
+    chunked prefill.  Admission reserves ``pages_needed(prompt,
+    max_new, extra=k)`` so the verify scatter always lands in owned
+    pages (rollback cannot leak); chunk sizes round up to a page
+    multiple so chunk boundaries stay page-aligned for the whole-page
+    prefill scatter and chunk-at-a-time prefix registration."""
+
+    def __init__(self, model: KVTransformerLM, *, draft=None,
+                 spec_k: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 dynamic_k: Optional[bool] = None, **kw):
+        self._spec_configure(model, draft=draft, spec_k=spec_k,
+                             prefill_chunk=prefill_chunk,
+                             dynamic_k=dynamic_k,
+                             spec_seed=kw.get("seed", 0))
+        kw.setdefault("name", "serve_spec_paged_lm")
+        super().__init__(model, **kw)
+
+    def _chunk_size(self) -> int:
+        c = self.prefill_chunk
+        if c <= 0:
+            return 0
+        P = self._kv.page_tokens
+        return -(-c // P) * P
+
+    def _chunk_prefill(self, toks: np.ndarray, starts: np.ndarray,
+                       takes: np.ndarray, slots: np.ndarray
+                       ) -> np.ndarray:
+        # the existing suffix-prefill program: `starts` (page-aligned
+        # by _chunk_size) is the prefix already cached, the chunk is
+        # the suffix — last-position logits come back directly
+        return np.asarray(
+            self._kv.prefill(toks, starts, takes, slots))
+
+    def _register_chunk(self, st: _ChunkState) -> None:
+        # content-address the pages this chunk completed (idempotent
+        # for pages registered by earlier chunks)
+        self._kv.register_prompt(st.seq.slot, st.req.tokens,
+                                 upto=st.seq.length)
+
+    def _verify_batch(self, cand: np.ndarray,
+                      active: np.ndarray) -> np.ndarray:
+        # inactive rows gather/scatter through scratch pages — a slot
+        # mid-chunked-prefill owns real pages its next chunk will
+        # fill, and candidate garbage must not touch them
+        return np.asarray(
+            self._kv.verify(cand, self._lengths, active=active))

@@ -24,16 +24,21 @@ Checks, in order:
    parity through slot recycling, the prefix-cache hit proof, the
    equal-HBM capacity win and the one-compiled-decode bound
    (``tests/test_paged_kv.py``; ``TP_CHECK_PAGED=0`` skips);
-7. **overlap** — the overlapped-train-loop bit-equality subset
+7. **speculative** — the speculative-decoding subset: greedy tokens
+   with a draft + verify pass bit-equal to plain decode on both cache
+   layouts (one verify program, zero decode programs), the paged
+   pool-exhaustion no-leak proof, and chunked-prefill parity
+   (``tests/test_speculative.py``; ``TP_CHECK_SPEC=0`` skips);
+8. **overlap** — the overlapped-train-loop bit-equality subset
    (``tests/test_overlap.py``; ``TP_CHECK_OVERLAP=0`` skips);
-8. **quant** — the quantized-path subset: int8 serving parity, the
+9. **quant** — the quantized-path subset: int8 serving parity, the
    fp8 shift-task A/B gate and the default-path bit-exactness
    (``tests/test_quant.py``; ``TP_CHECK_QUANT=0`` skips);
-9. **resilience** — the fault-tolerance subset: the crash-and-resume
+10. **resilience** — the fault-tolerance subset: the crash-and-resume
    A/B bit-equality, torn-save fallback, preemption final save and
    injector determinism (``tests/test_resilience.py``;
    ``TP_CHECK_FAULT=0`` skips);
-10. **static-analysis** — the ``tools/lint.py`` suite (graph verifier
+11. **static-analysis** — the ``tools/lint.py`` suite (graph verifier
    over the model zoo, tracing-hazard lint, lock-order checker,
    lockset race detector, env-knob drift incl. documented defaults;
    docs/static_analysis.md): zero unsuppressed findings (needs jax —
@@ -234,6 +239,42 @@ def check_paged(problems):
                         + "\n  ".join(tail))
 
 
+def check_speculative(problems):
+    """Speculative-decoding gate (docs/speculative_decoding.md):
+    greedy tokens with a same-weights draft through the k=2 verify
+    pass must be bit-equal to plain decode on the rectangular AND the
+    paged engine — with every proposal accepted, exactly one verify
+    program compiled and the decode program never compiled — plus the
+    pool-exhaustion no-leak proof (speculation under page pressure
+    returns every page) and rect chunked-prefill parity.  The heavy
+    tests carry ``@pytest.mark.slow`` so the tier-1 sweep skips them;
+    this gate runs them by id (needs jax — skip with
+    ``TP_CHECK_SPEC=0``)."""
+    if os.environ.get("TP_CHECK_SPEC", "1") == "0":
+        return
+    import subprocess
+
+    tests = "tests/test_speculative.py"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q",
+             "-p", "no:cacheprovider", "-p", "no:randomly",
+             tests + "::test_rect_greedy_bit_exact[2]",
+             tests + "::test_paged_greedy_bit_exact[2]",
+             tests + "::test_pool_exhaustion_mid_speculation_no_leak",
+             tests + "::test_chunked_prefill_parity_rect"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        problems.append("speculative: gate run did not finish: %s" % e)
+        return
+    if proc.returncode != 0:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-12:]
+        problems.append("speculative: speculative-decoding gate "
+                        "failed:\n  " + "\n  ".join(tail))
+
+
 def check_overlap(problems):
     """Overlap-equality gate (docs/input_pipeline.md): the bounded
     dispatch window, device staging, and on-device metrics must leave
@@ -370,6 +411,7 @@ def main():
     check_schedule(problems)
     check_serving(problems)
     check_paged(problems)
+    check_speculative(problems)
     check_overlap(problems)
     check_quant(problems)
     check_resilience(problems)
