@@ -412,6 +412,135 @@ def _paged_serving_record(small):
     return record
 
 
+def _fleet_record(small):
+    """Fleet-router sub-record (docs/fleet_serving.md): aggregate
+    goodput vs replica count (1/2/4) under a Zipf-shared-prefix
+    workload at the fixed SLO (``TP_BENCH_SERVE_SLO_MS``), the
+    prefix-aware vs round-robin A/B at 2 replicas (the prefix policy
+    concentrates each prefix group on one replica, so its pools record
+    more hits and skip more prefill), the shed fraction under a
+    tight-deadline overload (reject-at-admission goodput protection),
+    and the drain wall time with queues still deep."""
+    from incubator_mxnet_tpu import serving
+
+    rng = np.random.RandomState(0)
+    V, E, H, NL, S = (32, 32, 4, 1, 32) if small else (512, 256, 8, 4,
+                                                      256)
+    P = 16 if small else 32
+    slots = 2 if small else 4
+    pool_blocks = 16 if small else 64
+    new_tokens = 4 if small else 16
+    n_requests = 16 if small else 64
+    groups = 4 if small else 8
+    slo_ms = float(os.environ.get("TP_BENCH_SERVE_SLO_MS", "10000"))
+    params = _toy_lm_params(rng, V, E, NL, S)
+
+    # Zipf-skewed draws over shared prefixes: one full page + 1 token
+    # shared per group, so a prefix hit skips most of the prompt
+    prefixes = [rng.randint(0, V, size=P + 1).astype(np.int32)
+                for _ in range(groups)]
+    probs = 1.0 / np.arange(1, groups + 1)
+    probs /= probs.sum()
+    reqs = []
+    for _ in range(n_requests):
+        g = int(rng.choice(groups, p=probs))
+        sfx = rng.randint(0, V, size=1 + g % 3).astype(np.int32)
+        reqs.append(np.concatenate([prefixes[g], sfx]))
+
+    def run(n_replicas, policy, overload_and_drain=False):
+        engines = [serving.PagedGenerationEngine(
+            serving.KVTransformerLM(params, heads=H), max_slots=slots,
+            max_len=S, page_tokens=P, pool_blocks=pool_blocks)
+            for _ in range(n_replicas)]
+        reps = [serving.EngineReplica(e, "r%d" % i)
+                for i, e in enumerate(engines)]
+        router = serving.ServingRouter(reps, policy=policy,
+                                       heartbeat_s=0.2)
+        for e in engines:  # compile outside the timed window
+            e.generate(reqs[0], max_new_tokens=2, timeout=600)
+        t0 = time.perf_counter()
+        futs = []
+        for p in reqs:
+            futs.append(router.submit(p, max_new_tokens=new_tokens,
+                                      deadline_ms=slo_ms))
+        ok = expired = 0
+        for f in futs:
+            try:
+                f.result(timeout=600)
+                ok += 1
+            except Exception:
+                expired += 1
+        dt = time.perf_counter() - t0
+        router.poll()  # fold the final reports into the mirrors
+        desc = router.describe()
+        row = {"replicas": n_replicas, "policy": policy,
+               "offered": len(reqs), "ok": ok, "expired": expired,
+               "goodput_tokens_per_sec":
+                   round(ok * new_tokens / dt, 1),
+               "prefix_routed": desc["prefix_routed"],
+               "pool_prefix_hits":
+                   sum(e.pool.stats.prefix_hits for e in engines),
+               "pool_prefix_hit_tokens":
+                   sum(e.pool.stats.prefix_hit_tokens
+                       for e in engines)}
+        if overload_and_drain:
+            # overload: deadlines ~3x the measured per-request EWMA —
+            # once a couple of requests stack per slot the router's
+            # ETA exceeds slack*deadline and admission sheds
+            est_s = max(
+                float((r["report"] or {}).get("est_request_s") or 0.0)
+                for r in desc["replicas"].values())
+            tight_ms = max(est_s * 3e3, 50.0)
+            offered = 3 * n_requests
+            shed = 0
+            ofuts = []
+            for i in range(offered):
+                try:
+                    ofuts.append(router.submit(
+                        reqs[i % len(reqs)],
+                        max_new_tokens=new_tokens,
+                        deadline_ms=tight_ms))
+                except Exception:
+                    shed += 1
+            t_drain = time.perf_counter()
+            # drain one replica while its queue is still deep: the
+            # drain wall time IS the wait for its in-flight work
+            drain_s = router.drain(reps[-1].name, timeout=600.0)
+            for f in ofuts:
+                try:
+                    f.result(timeout=600)
+                except Exception:
+                    pass
+            row["overload"] = {
+                "offered": offered, "shed": shed,
+                "shed_frac": round(shed / offered, 3),
+                "deadline_ms": round(tight_ms, 1),
+                "shed_by_reason": dict(
+                    router.describe()["shed"])}
+            row["drain_seconds"] = round(drain_s, 3)
+            row["drain_started_after_s"] = round(
+                t_drain - t0, 3)
+        router.close()
+        for e in engines:
+            e.close()
+        return row
+
+    record = {"metric": "fleet_goodput_tokens_per_sec",
+              "unit": "tokens/s", "slo_ms": slo_ms,
+              "page_tokens": P, "replica_slots": slots,
+              "pool_blocks": pool_blocks, "requests": n_requests,
+              "prefix_groups": groups, "new_tokens": new_tokens,
+              "scaling": [run(n, "prefix") for n in (1, 2)]}
+    record["scaling"].append(run(4, "prefix",
+                                 overload_and_drain=True))
+    record["ab_2replica"] = {
+        "prefix": record["scaling"][1],
+        "round_robin": run(2, "round_robin")}
+    record["value"] = \
+        record["scaling"][1]["goodput_tokens_per_sec"]
+    return record
+
+
 def _speculative_record(small):
     """Speculative-decoding sub-record (docs/speculative_decoding.md):
     engine decode tokens/s at batch 1 and the full slot batch for
@@ -845,6 +974,10 @@ def main():
     # verify-pass decode A/B at batch 1 / full slots for k∈{0,2,4} with
     # f32 and int8 drafts, and the chunked-prefill TTFT p50/p99 A/B
     combined["speculative"] = _speculative_record(small)
+    # fleet sub-record (docs/fleet_serving.md): goodput vs replica
+    # count, prefix-aware vs round-robin A/B on the Zipf workload,
+    # overload shed fraction, and the live-drain wall time
+    combined["fleet"] = _fleet_record(small)
     # quantization sub-record (docs/quantization.md): int8 weight-only
     # decode A/B at batch 1/8 + parked HBM weight bytes, and the same
     # flagship train step with fp8 delayed-scaling matmuls — defaults

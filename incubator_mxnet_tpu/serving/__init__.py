@@ -1,15 +1,18 @@
 """Serving subsystem: bucketed dynamic batching (:mod:`.engine`),
 KV-cache continuous-batching generation (:mod:`.generate`), the paged
-KV cache with prefix caching (:mod:`.paged`), and speculative decoding
-with chunked prefill (:mod:`.speculative`).
+KV cache with prefix caching (:mod:`.paged`), speculative decoding
+with chunked prefill (:mod:`.speculative`), and the fleet router over
+N replicas (:mod:`.router`).
 
-See docs/serving.md, docs/paged_kv.md and docs/speculative_decoding.md
-for the architecture and knob tables."""
+See docs/serving.md, docs/paged_kv.md, docs/speculative_decoding.md
+and docs/fleet_serving.md for the architecture and knob tables."""
 from .engine import InferenceEngine, bucket_batch, bucket_length
 from .generate import (GenerationEngine, GenerationResult,
                        KVTransformerLM, LMSpec)
 from .paged import (BlockPool, PagedGenerationEngine, PagedKVCache,
                     prefix_hashes)
+from .router import (EngineReplica, Replica, ReplicaServer,
+                     ServingRouter, TcpReplica, TenantQuota)
 from .speculative import (DraftModel, PagedSpeculativeGenerationEngine,
                           SpeculativeGenerationEngine)
 
@@ -17,4 +20,6 @@ __all__ = ["InferenceEngine", "GenerationEngine", "GenerationResult",
            "KVTransformerLM", "LMSpec", "BlockPool", "PagedKVCache",
            "PagedGenerationEngine", "prefix_hashes", "bucket_batch",
            "bucket_length", "DraftModel", "SpeculativeGenerationEngine",
-           "PagedSpeculativeGenerationEngine"]
+           "PagedSpeculativeGenerationEngine", "Replica",
+           "EngineReplica", "TcpReplica", "ReplicaServer",
+           "TenantQuota", "ServingRouter"]

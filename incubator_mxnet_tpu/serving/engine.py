@@ -110,6 +110,33 @@ class ServeStats:
         """Fraction of launched batch rows that were padding."""
         return self.padded_rows / self.rows if self.rows else 0.0
 
+    def snapshot(self) -> Dict[str, float]:
+        """One consistent read of every counter, taken under the lock.
+
+        The fleet router's ``load_report()`` heartbeat reads these from
+        a different thread than the serve loop that mutates them — a
+        field-by-field unlocked read could observe e.g. ``spec_accepted``
+        from one verify run and ``spec_proposed`` from the next, so the
+        probe contract is: mirrors leave this object only via snapshot.
+        """
+        with self.lock:
+            return {
+                "requests": self.requests,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "batches": self.batches,
+                "rows": self.rows,
+                "padded_rows": self.padded_rows,
+                "num_compiles": len(self.compile_keys),
+                "spec_proposed": self.spec_proposed,
+                "spec_accepted": self.spec_accepted,
+                "spec_runs": self.spec_runs,
+                "spec_accept_rate": (self.spec_accepted
+                                     / self.spec_proposed
+                                     if self.spec_proposed else 0.0),
+                "prefill_chunks": self.prefill_chunks,
+            }
+
     def record_batch(self, key, n: int, bucket: int, phase: str) -> None:
         with self.lock:
             self.batches += 1

@@ -701,6 +701,10 @@ class GenerationEngine:
         # shared by several engines, e.g. an A/B bench)
         self.active_high_water = 0
         self.prefill_tokens = 0
+        # EWMA of completed-request wall time (written by the loop
+        # thread in _finish, read by load_report — both under _cond):
+        # the router's deadline-shedding ETA estimate
+        self._req_ewma = 0.0
         self._setup_cache()
         self._seqs: List[Optional[_Seq]] = [None] * self.max_slots
         self._lengths = np.zeros(self.max_slots, np.int32)
@@ -799,6 +803,48 @@ class GenerationEngine:
     @property
     def active_slots(self) -> int:
         return sum(1 for s in self._seqs if s is not None)
+
+    # --------------------------------------------------------------- probe
+    def load_report(self) -> Dict[str, object]:
+        """Cheap lock-safe load snapshot: the fleet router's heartbeat
+        probe (docs/fleet_serving.md).
+
+        Engine queue/slot state is read under ``_cond`` and the stats
+        mirrors via :meth:`ServeStats.snapshot` (under ``stats.lock``)
+        — never field-by-field unlocked, so the router always sees a
+        consistent picture.  The paged engine overrides this to add
+        real page occupancy and the pool's registered prefix digests
+        (the router's placement key); the rectangular engine reports
+        slots as pages so the router's capacity math stays uniform, and
+        its empty digest tuple disables prefix scoring for it.
+        """
+        st = self.stats.snapshot()
+        with self._cond:
+            queued = len(self._pending)
+            active = sum(1 for s in self._seqs if s is not None)
+            closed = self._closed
+            est = self._req_ewma
+        free = max(0, self.max_slots - active)
+        report: Dict[str, object] = {
+            "name": self.name,
+            "closed": closed,
+            "max_slots": self.max_slots,
+            "max_len": self.max_len,
+            "active_slots": active,
+            "free_slots": free,
+            "queue_depth": queued,
+            "est_request_s": est,
+            "requests": st["requests"],
+            "spec_accept_rate": st["spec_accept_rate"],
+            "num_compiles": st["num_compiles"],
+            "page_tokens": 0,
+            "free_pages": free,
+            "total_pages": self.max_slots,
+            "prefix_digests": (),
+        }
+        telemetry.gauge("serve_free_slots").set(free)
+        telemetry.gauge("serve_active_slots").set(active)
+        return report
 
     # ------------------------------------------------------------- the loop
     def _next_key(self):
@@ -978,10 +1024,13 @@ class GenerationEngine:
         self._release(seq.slot)
         with self.stats.lock:
             self.stats.requests += 1
+        dur = time.monotonic() - seq.req.t_submit
+        with self._cond:
+            self._req_ewma = (dur if self._req_ewma == 0.0
+                              else 0.8 * self._req_ewma + 0.2 * dur)
         telemetry.counter("serve_requests_total").inc()
         telemetry.counter("serve_slot_recycles_total").inc()
-        telemetry.histogram("serve_request_seconds").observe(
-            time.monotonic() - seq.req.t_submit)
+        telemetry.histogram("serve_request_seconds").observe(dur)
         seq.req.future.set_result(res)
 
     # -------------------------------------------------------------- decode

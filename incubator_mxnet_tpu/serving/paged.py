@@ -157,6 +157,26 @@ class BlockPool:
         with self.lock:
             return int(self._ref[blk])
 
+    def snapshot(self) -> Dict[str, object]:
+        """One consistent under-lock snapshot of pool occupancy plus
+        the registered prefix digests — the paged half of the fleet
+        router's ``load_report()`` probe.  Separate ``free_blocks()`` /
+        ``cached_blocks()`` calls could interleave with an alloc and
+        report pages that sum to more than the pool; the probe contract
+        is one critical section per report."""
+        with self.lock:
+            free = len(self._free)
+            cached = len(self._lru)
+            return {
+                "free": free,
+                "cached": cached,
+                "used": self.num_blocks - free - cached,
+                "digests": frozenset(self._block_of),
+                "prefix_hits": self.stats.prefix_hits,
+                "prefix_hit_tokens": self.stats.prefix_hit_tokens,
+                "evictions": self.stats.evictions,
+            }
+
     # ------------------------------------------------------------- lifecycle
     def alloc(self, n: int) -> Optional[List[int]]:
         """Take ``n`` fresh private blocks (refcount 1, unhashed),
@@ -887,6 +907,26 @@ class PagedGenerationEngine(GenerationEngine):
         a request that will never be seated."""
         if req.slot is not None:
             self._kv.release_slot(req.slot)
+
+    # --------------------------------------------------------------- probe
+    def load_report(self) -> Dict[str, object]:
+        """The rectangular probe plus real page occupancy and the
+        pool's registered prefix digests (the fleet router's placement
+        key).  ``free_pages`` counts allocatable pages — free plus
+        cached-evictable, what :meth:`BlockPool.alloc` could actually
+        deliver — from ONE pool critical section
+        (:meth:`BlockPool.snapshot`)."""
+        report = super().load_report()
+        snap = self._kv.pool.snapshot()
+        report.update(
+            page_tokens=self._kv.page_tokens,
+            free_pages=int(snap["free"]) + int(snap["cached"]),
+            cached_pages=int(snap["cached"]),
+            total_pages=self._kv.num_blocks,
+            prefix_digests=snap["digests"],
+            prefix_hits=int(snap["prefix_hits"]),
+        )
+        return report
 
     # ------------------------------------------------------------- decode
     def _decode_batch(self, tokens: np.ndarray) -> np.ndarray:

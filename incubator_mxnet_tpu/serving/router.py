@@ -1,0 +1,1056 @@
+"""Fleet front door: a multi-replica serving router with prefix-aware
+placement, tenant quotas, deadline shedding, health-checked failover,
+and live draining.
+
+The single-replica stack (continuous batching, paged KV with prefix
+caching, speculative decoding) scales *up*; this module scales *out*
+(ROADMAP item: the "millions of users" story is horizontal).  A
+:class:`ServingRouter` fronts N replicas — in-process engines wrapped
+in :class:`EngineReplica`, or remote engines behind a
+:class:`ReplicaServer` reached through :class:`TcpReplica` over the
+``ps.py`` length-prefixed-pickle framing — and places each request by:
+
+1. **Session affinity** — a sticky ``session -> replica`` map with a
+   TTL (``TP_ROUTER_SESSION_TTL_S``): a conversation keeps landing
+   where its KV prefix already lives.
+2. **Prefix-aware placement** — the router mirrors each replica's
+   registered prefix-hash chains (fed by the ``engine.load_report()``
+   heartbeat probe) and scores candidates by the longest leading
+   match of the request's own rolling blake2b chain
+   (``paged.prefix_hashes``).  Equal digests mean equal whole
+   prefixes, so the score is exactly the token count the replica's
+   prefill would skip.  Between heartbeats the mirror is extended
+   optimistically with the chains of requests just routed there.
+3. **Power-of-two-choices fallback** — no prefix signal: sample two
+   candidates, take the less loaded (load = (active + queued +
+   placed-since-report) / slots).  ``TP_ROUTER_POLICY`` selects
+   ``prefix`` (default), ``p2c``, or ``round_robin``.
+
+Goodput protection happens **at admission, never after prefill
+spend**: per-tenant token buckets (:class:`TenantQuota`, LM tokens per
+second), deadline classes (``interactive`` / ``batch`` with default
+SLOs ``TP_ROUTER_INTERACTIVE_SLO_MS`` / ``TP_ROUTER_BATCH_SLO_MS``),
+and an ETA estimate per replica (queue depth x the engine's completed-
+request EWMA) — a request no live replica can finish inside
+``slack * deadline`` is rejected synchronously from :meth:`submit`
+with ``MXNetError`` instead of being queued to miss its SLO after
+burning prefill FLOPs.
+
+Health: a heartbeat thread polls ``load_report()`` every
+``TP_ROUTER_HEARTBEAT_S``; a replica silent past ``TP_ROUTER_DEAD_S``
+(the ps.py ``_deadnode_timeout`` idiom) is marked dead — its in-flight
+requests fail fast, and retryable ones re-route to a surviving replica
+(at most ``TP_ROUTER_RETRIES`` times; the router future resolves
+exactly once, first settle wins).  :meth:`drain` stops new placements
+on one replica, waits for its in-flight work, then detaches it — the
+zero-downtime deploy primitive.
+
+Locking: ONE router condition guards every mutable field; replica
+calls (``submit`` / ``load_report``) always happen OUTSIDE it, so the
+router lock never nests around an engine lock and never holds across
+network or device waits (the ``tools/lint.py`` locks pass covers this
+module).
+
+Telemetry: ``fleet_requests_total{tenant,class}``,
+``fleet_routed_prefix_hits_total``, ``fleet_prefix_hit_tokens_total``,
+``fleet_shed_total{reason}``, ``fleet_replica_dead_total``,
+``fleet_retries_total``, ``fleet_drain_seconds``,
+``fleet_replicas_alive``.  See docs/fleet_serving.md.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from .. import ps as _ps
+from .. import telemetry
+from ..analysis.race_checker import race_audit
+from ..base import MXNetError, get_env
+from .generate import GenerationResult
+from .paged import prefix_hashes
+
+__all__ = ["Replica", "EngineReplica", "ReplicaServer", "TcpReplica",
+           "TenantQuota", "ServingRouter"]
+
+DEADLINE_CLASSES = ("interactive", "batch")
+
+
+# ---------------------------------------------------------------------------
+# replica handles
+# ---------------------------------------------------------------------------
+
+
+class Replica:
+    """What the router needs from a replica — a tiny protocol so an
+    in-process engine and a TCP-backed remote engine interchange.
+
+    ``name`` must be unique within one router.  ``submit`` mirrors
+    ``GenerationEngine.submit`` (returns a Future of
+    :class:`~.generate.GenerationResult`, raises ``MXNetError``
+    synchronously on rejection); ``load_report`` mirrors
+    ``GenerationEngine.load_report``.
+    """
+
+    name = "replica"
+
+    def submit(self, tokens, max_new_tokens: int = 16, **kw) -> Future:
+        raise NotImplementedError
+
+    def load_report(self) -> Dict[str, object]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class EngineReplica(Replica):
+    """In-process replica: a named handle over one engine (any
+    :class:`~.generate.GenerationEngine` subclass).  The wrapper exists
+    so two engines with the same engine ``name`` can still join one
+    fleet under distinct replica names."""
+
+    def __init__(self, engine, name: Optional[str] = None):
+        self.engine = engine
+        self.name = name or engine.name
+
+    def submit(self, tokens, max_new_tokens: int = 16, **kw) -> Future:
+        return self.engine.submit(tokens, max_new_tokens, **kw)
+
+    def load_report(self) -> Dict[str, object]:
+        return self.engine.load_report()
+
+    def close(self) -> None:
+        self.engine.close()
+
+
+class ReplicaServer(_ps._Node):
+    """Expose one engine over the ``ps.py`` framing (length-prefixed
+    pickle on a persistent connection, the ``_ConnPool`` channel
+    idiom).
+
+    Every message carries a client-chosen ``rid``; every reply echoes
+    it, so responses can arrive out of submission order — ``submit``
+    replies are sent from the engine future's done-callback (the
+    engine's loop thread) while the handler thread keeps reading.  All
+    replies to one connection serialize through a per-connection write
+    lock so frames never interleave."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
+        super().__init__(host, port)
+        self.engine = engine
+        self.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def close(self) -> None:
+        self.stop()
+
+    @staticmethod
+    def _send_lock(handler) -> threading.Lock:
+        # created by the handler thread before any callback can exist
+        # for this connection, so there is a single racing creator
+        lk = getattr(handler, "tp_wlock", None)
+        if lk is None:
+            lk = handler.tp_wlock = threading.Lock()
+        return lk
+
+    def _reply(self, handler, wlock, payload) -> None:
+        try:
+            with wlock:
+                _ps._send_msg(handler.request, payload)
+        except OSError:
+            pass  # peer gone; its reader fails the waiters
+
+    def _reply_result(self, handler, wlock, rid, fut) -> None:
+        exc = fut.exception()
+        if exc is not None:
+            self._reply(handler, wlock, {"status": "error", "rid": rid,
+                                         "error": repr(exc)})
+            return
+        r = fut.result()
+        self._reply(handler, wlock, {
+            "status": "ok", "rid": rid,
+            "tokens": np.asarray(r.tokens, np.int32),
+            "logits": r.logits, "prompt_len": int(r.prompt_len),
+            "ttft_s": float(r.ttft_s)})
+
+    def _handle(self, msg, handler):
+        wlock = self._send_lock(handler)
+        rid = None
+        try:
+            rid = msg.get("rid")
+            cmd = msg.get("cmd")
+            if cmd == "load_report":
+                self._reply(handler, wlock, {
+                    "status": "ok", "rid": rid,
+                    "report": self.engine.load_report()})
+            elif cmd == "submit":
+                fut = self.engine.submit(
+                    np.asarray(msg["tokens"], np.int32),
+                    int(msg["max_new"]), **(msg.get("kw") or {}))
+                fut.add_done_callback(
+                    lambda f, r=rid, h=handler, w=wlock:
+                    self._reply_result(h, w, r, f))
+            else:
+                self._reply(handler, wlock, {
+                    "status": "error", "rid": rid,
+                    "error": "unknown cmd %r" % (cmd,)})
+        except Exception as exc:  # noqa: BLE001 — shipped to the peer
+            self._reply(handler, wlock, {"status": "error", "rid": rid,
+                                         "error": repr(exc)})
+        return _ps._NO_REPLY
+
+
+def _relay_result(raw: Future, out: Future) -> None:
+    """Map a raw wire-reply future onto a GenerationResult future."""
+    if out.done():
+        return
+    exc = raw.exception()
+    if exc is not None:
+        out.set_exception(exc)
+        return
+    msg = raw.result()
+    out.set_result(GenerationResult(
+        np.asarray(msg["tokens"], np.int32), msg.get("logits"),
+        int(msg["prompt_len"]), -1, float(msg["ttft_s"])))
+
+
+@race_audit
+class TcpReplica(Replica):
+    """Client handle to a :class:`ReplicaServer`: one persistent
+    socket (the ``_ConnPool`` idiom — no per-request connect churn), a
+    write lock serializing outbound frames, and a reader thread
+    dispatching replies to per-request futures by ``rid``.
+
+    A broken connection fails every outstanding future and poisons the
+    handle (``submit``/``load_report`` raise) — the router's heartbeat
+    then marks the replica dead and re-routes; reconnection is a new
+    ``TcpReplica``, not a hidden retry."""
+
+    def __init__(self, addr: Tuple[str, int],
+                 name: Optional[str] = None, *,
+                 timeout: Optional[float] = None,
+                 connect_retry: float = 5.0):
+        self.addr = (str(addr[0]), int(addr[1]))
+        self.name = name or "tcp://%s:%d" % self.addr
+        self._timeout = float(
+            timeout if timeout is not None
+            else get_env("ROUTER_RPC_TIMEOUT", 60.0, float))
+        self._sock = _ps._connect(self.addr, self._timeout,
+                                  connect_retry)
+        self._lock = threading.Lock()
+        self._wlock = threading.Lock()
+        self._next_rid = 0
+        self._waiters: Dict[int, Future] = {}
+        self._broken: Optional[BaseException] = None
+        self._reader = threading.Thread(
+            target=self._read_loop, name=self.name + "-reader",
+            daemon=True)
+        self._reader.start()
+
+    # ------------------------------------------------------------ wire
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                msg = _ps._recv_msg(self._sock)
+            except (ConnectionError, OSError) as exc:
+                self._fail_pending(exc)
+                return
+            with self._lock:
+                fut = self._waiters.pop(msg.get("rid"), None)
+            if fut is None or fut.done():
+                continue
+            if msg.get("status") == "ok":
+                fut.set_result(msg)
+            else:
+                fut.set_exception(MXNetError(
+                    "replica %s: %s" % (self.name, msg.get("error"))))
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._broken is None:
+                self._broken = exc
+            waiters, self._waiters = self._waiters, {}
+        err = MXNetError("replica %s connection lost: %r"
+                         % (self.name, exc))
+        for fut in waiters.values():
+            if not fut.done():
+                fut.set_exception(err)
+
+    def _call(self, msg: Dict[str, object]) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if self._broken is not None:
+                raise MXNetError(
+                    "replica %s connection lost: %r"
+                    % (self.name, self._broken))
+            self._next_rid += 1
+            rid = self._next_rid
+            self._waiters[rid] = fut
+        msg["rid"] = rid
+        try:
+            with self._wlock:
+                # bounded: the socket carries the connect timeout, so
+                # sendall cannot stall past it
+                _ps._send_msg(self._sock, msg)
+        except (ConnectionError, OSError) as exc:
+            with self._lock:
+                self._waiters.pop(rid, None)
+            self._fail_pending(exc)
+            raise MXNetError("replica %s send failed: %r"
+                             % (self.name, exc))
+        return fut
+
+    # ------------------------------------------------------------- api
+    def submit(self, tokens, max_new_tokens: int = 16, **kw) -> Future:
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        raw = self._call({"cmd": "submit", "tokens": toks,
+                          "max_new": int(max_new_tokens), "kw": kw})
+        out: Future = Future()
+        raw.add_done_callback(lambda f: _relay_result(f, out))
+        return out
+
+    def load_report(self) -> Dict[str, object]:
+        reply = self._call({"cmd": "load_report"}).result(
+            timeout=self._timeout)
+        return reply["report"]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._broken is None:
+                self._broken = MXNetError(
+                    "replica %s closed" % self.name)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# admission policy pieces
+# ---------------------------------------------------------------------------
+
+
+class TenantQuota:
+    """Token bucket in LM tokens (prompt + max_new) per second.
+
+    ``rate`` refills continuously up to ``burst`` (default:
+    ``max(rate, 1)``); a request costing more than the current level
+    is shed at admission.  Mutated only under the router lock."""
+
+    __slots__ = ("rate", "burst", "level", "t")
+
+    def __init__(self, rate: float, burst: Optional[float] = None):
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None
+                           else max(self.rate, 1.0))
+        self.level = self.burst
+        self.t: Optional[float] = None
+
+    def try_take(self, n: float, now: float) -> bool:
+        if self.t is None:
+            self.t = now
+        self.level = min(self.burst,
+                         self.level + (now - self.t) * self.rate)
+        self.t = now
+        if n <= self.level:
+            self.level -= n
+            return True
+        return False
+
+
+class _Placement:
+    """One routed request's router-side record.  Every mutable field
+    is guarded by the router lock; ``epoch`` invalidates done-callbacks
+    of dispatches that were superseded by a re-route."""
+
+    __slots__ = ("rid", "tokens", "max_new", "kw", "tenant", "klass",
+                 "session", "retryable", "deadline", "chains", "tried",
+                 "retries_left", "epoch", "state", "done", "last_exc",
+                 "future", "t_submit")
+
+    def __init__(self, rid, tokens, max_new, kw, tenant, klass,
+                 session, retryable, deadline, chains, retries):
+        self.rid = rid
+        self.tokens = tokens
+        self.max_new = max_new
+        self.kw = kw
+        self.tenant = tenant
+        self.klass = klass
+        self.session = session
+        self.retryable = retryable
+        self.deadline = deadline          # absolute monotonic or None
+        self.chains = chains              # page_tokens -> digest chain
+        self.tried: Set[str] = set()
+        self.retries_left = retries
+        self.epoch = 0
+        self.state = None                 # current _ReplicaState
+        self.done = False
+        self.last_exc: Optional[BaseException] = None
+        self.future: Future = Future()
+        self.t_submit = time.monotonic()
+
+
+class _ReplicaState:
+    """Router-side mirror of one replica: last load report, the prefix
+    digest mirror, and the in-flight placements.  Guarded by the
+    router lock (the replica handle itself is only ever called outside
+    it)."""
+
+    __slots__ = ("replica", "name", "alive", "draining", "report",
+                 "last_ok", "misses", "placed", "digests", "inflight")
+
+    def __init__(self, replica):
+        self.replica = replica
+        self.name = replica.name
+        self.alive = True
+        self.draining = False
+        self.report: Optional[Dict[str, object]] = None
+        self.last_ok = time.monotonic()
+        self.misses = 0
+        # placements routed since the last report: de-stales the
+        # report's free_slots/queue_depth between heartbeats
+        self.placed = 0
+        self.digests: Set[bytes] = set()
+        self.inflight: Dict[int, _Placement] = {}
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+
+@race_audit
+class ServingRouter:
+    """Thread-safe front door over N generation replicas.
+
+    ``submit`` admits (quota, deadline feasibility), places (sticky
+    session, then prefix score, then power-of-two-choices), dispatches
+    to the chosen replica, and returns a Future resolving to that
+    replica's :class:`~.generate.GenerationResult`.  Admission
+    failures raise ``MXNetError`` synchronously — shedding happens
+    before any prefill spend, never after.
+
+    See the module docstring for the placement and failover contracts,
+    and docs/fleet_serving.md for the knob and telemetry tables.
+    """
+
+    def __init__(self, replicas=(), *, policy: Optional[str] = None,
+                 session_ttl_s: Optional[float] = None,
+                 heartbeat_s: Optional[float] = None,
+                 dead_after_s: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 slack: Optional[float] = None,
+                 drain_timeout_s: Optional[float] = None,
+                 seed: int = 0, name: str = "fleet"):
+        self.name = name
+        self.policy = str(policy if policy is not None
+                          else get_env("ROUTER_POLICY", "prefix"))
+        if self.policy not in ("prefix", "p2c", "round_robin"):
+            raise MXNetError(
+                "TP_ROUTER_POLICY must be prefix|p2c|round_robin, "
+                "got %r" % (self.policy,))
+        self._session_ttl = float(
+            session_ttl_s if session_ttl_s is not None
+            else get_env("ROUTER_SESSION_TTL_S", 300.0, float))
+        self._heartbeat_s = float(
+            heartbeat_s if heartbeat_s is not None
+            else get_env("ROUTER_HEARTBEAT_S", 1.0, float))
+        self._dead_after_s = float(
+            dead_after_s if dead_after_s is not None
+            else get_env("ROUTER_DEAD_S", 5.0, float))
+        self._retries = int(retries if retries is not None
+                            else get_env("ROUTER_RETRIES", 1, int))
+        self._slack = float(slack if slack is not None
+                            else get_env("ROUTER_SLACK", 0.8, float))
+        self._drain_timeout = float(
+            drain_timeout_s if drain_timeout_s is not None
+            else get_env("ROUTER_DRAIN_TIMEOUT_S", 120.0, float))
+        self._class_slo = {
+            "interactive": get_env("ROUTER_INTERACTIVE_SLO_MS", 0.0,
+                                   float),
+            "batch": get_env("ROUTER_BATCH_SLO_MS", 0.0, float),
+        }
+        self._lock = threading.Condition()
+        self._replicas: Dict[str, _ReplicaState] = {}
+        self._sessions: Dict[str, Tuple[str, float]] = {}
+        self._buckets: Dict[str, TenantQuota] = {}
+        self._rng = random.Random(seed)
+        self._rr = 0
+        self._next_rid = 0
+        self._closed = False
+        # host-side mirrors (tests/bench read without telemetry)
+        self._n_requests = 0
+        self._prefix_routed = 0
+        self._retries_n = 0
+        self._deaths = 0
+        self._shed: Dict[str, int] = {}
+        for r in replicas:
+            self.attach(r)
+        self._stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name=name + "-heartbeat",
+            daemon=True)
+        self._hb_thread.start()
+
+    # ------------------------------------------------------------ membership
+    def attach(self, replica) -> None:
+        """Add a replica (anything satisfying the :class:`Replica`
+        protocol; a bare engine works too) and probe it once so
+        placement has a report before the first heartbeat."""
+        st = _ReplicaState(replica)
+        with self._lock:
+            if self._closed:
+                raise MXNetError("router %r is closed" % self.name)
+            if st.name in self._replicas:
+                raise MXNetError(
+                    "replica name %r already attached — wrap it in "
+                    "EngineReplica(engine, name=...) for a unique "
+                    "name" % (st.name,))
+            self._replicas[st.name] = st
+        self._probe(st)
+
+    def detach(self, replica) -> None:
+        """Remove a replica immediately (no drain: its in-flight
+        requests keep their state and settle normally)."""
+        name = replica if isinstance(replica, str) else replica.name
+        with self._lock:
+            self._replicas.pop(name, None)
+            for s in [s for s, (n, _) in self._sessions.items()
+                      if n == name]:
+                del self._sessions[s]
+
+    @property
+    def replicas(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def session_replica(self, session: str) -> Optional[str]:
+        """The replica a live session is pinned to (None once the TTL
+        lapsed)."""
+        with self._lock:
+            ent = self._sessions.get(session)
+            if ent is None or time.monotonic() >= ent[1]:
+                return None
+            return ent[0]
+
+    def set_quota(self, tenant: str, rate: float,
+                  burst: Optional[float] = None) -> None:
+        """Install/replace a tenant's token bucket (LM tokens/s)."""
+        with self._lock:
+            self._buckets[tenant] = TenantQuota(rate, burst)
+
+    # ------------------------------------------------------------- admission
+    def submit(self, tokens, max_new_tokens: int = 16, *,
+               tenant: str = "default", klass: str = "interactive",
+               session: Optional[str] = None, retryable: bool = True,
+               deadline_ms: Optional[float] = None, **kw) -> Future:
+        """Admit, place, and dispatch one request.  Raises
+        ``MXNetError`` synchronously when shed (quota exhausted, no
+        replica can meet the deadline, or no replica can ever fit the
+        request) — rejection always happens before prefill spend."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        if toks.size < 1:
+            raise MXNetError("empty prompt")
+        if klass not in DEADLINE_CLASSES:
+            raise MXNetError("deadline class must be one of %s, got %r"
+                             % (DEADLINE_CLASSES, klass))
+        if deadline_ms is None:
+            slo = self._class_slo[klass]
+            deadline_ms = float(slo) if slo and slo > 0 else None
+        now = time.monotonic()
+        deadline = now + deadline_ms / 1e3 \
+            if deadline_ms is not None else None
+        # digest chains per page size seen in the fleet, computed
+        # OUTSIDE the lock (hashing is the expensive part of routing)
+        with self._lock:
+            sizes = {int((st.report or {}).get("page_tokens") or 0)
+                     for st in self._replicas.values()}
+        chains = {P: prefix_hashes(toks, P)
+                  for P in sizes if P > 0}
+        with self._lock:
+            if self._closed:
+                raise MXNetError("router %r is closed" % self.name)
+            self._next_rid += 1
+            rec = _Placement(self._next_rid, toks,
+                             int(max_new_tokens), kw, tenant, klass,
+                             session, retryable, deadline, chains,
+                             self._retries)
+            quota = self._buckets.get(tenant)
+            if quota is not None and not quota.try_take(
+                    toks.size + rec.max_new, now):
+                self._shed_locked(rec, "quota",
+                                  "tenant %r token bucket empty"
+                                  % (tenant,))
+            st = self._pick(rec, now)
+            self._n_requests += 1
+        telemetry.counter("fleet_requests_total",
+                          {"tenant": tenant, "class": klass}).inc()
+        if not self._dispatch_once(rec, st):
+            self._route(rec)
+        return rec.future
+
+    def generate(self, tokens, max_new_tokens: int = 16,
+                 timeout: Optional[float] = 120.0,
+                 **kw) -> GenerationResult:
+        """Synchronous convenience wrapper over :meth:`submit`."""
+        return self.submit(tokens, max_new_tokens, **kw).result(
+            timeout=timeout)
+
+    # ------------------------------------------------------------- placement
+    def _shed_locked(self, rec: _Placement, reason: str,
+                     detail: str) -> None:
+        """Count and raise an admission rejection (lock held)."""
+        self._shed[reason] = self._shed.get(reason, 0) + 1
+        telemetry.counter("fleet_shed_total",
+                          {"reason": reason}).inc()
+        raise MXNetError(
+            "fleet shed [%s] tenant=%r class=%r: %s"
+            % (reason, rec.tenant, rec.klass, detail))
+
+    def _fits(self, st: _ReplicaState, rec: _Placement) -> bool:
+        """Could this replica EVER run the request (static capability,
+        not current load)?"""
+        r = st.report
+        if r is None:
+            return True  # not probed yet: optimistic
+        if r.get("closed"):
+            return False
+        if rec.tokens.size + rec.max_new > int(r.get("max_len") or
+                                               1 << 30):
+            return False
+        P = int(r.get("page_tokens") or 0)
+        if P:
+            need = -(-(rec.tokens.size + rec.max_new) // P)
+            if need > int(r.get("total_pages") or need):
+                return False
+        return True
+
+    def _load(self, st: _ReplicaState) -> float:
+        r = st.report
+        if r is None:
+            return float(st.placed)
+        slots = max(1, int(r.get("max_slots") or 1))
+        return (int(r.get("active_slots") or 0)
+                + int(r.get("queue_depth") or 0)
+                + st.placed) / slots
+
+    def _eta_ms(self, st: _ReplicaState) -> float:
+        """Optimistic finish-time estimate: the engine's completed-
+        request EWMA scaled by how many batch waves precede a new
+        arrival.  Cold engines (EWMA 0) estimate 0 — admit and let
+        measurements accumulate."""
+        r = st.report
+        if r is None:
+            return 0.0
+        est = float(r.get("est_request_s") or 0.0) * 1e3
+        free = int(r.get("free_slots") or 0) - st.placed
+        if free > 0:
+            return est
+        q = int(r.get("queue_depth") or 0) + st.placed
+        slots = max(1, int(r.get("max_slots") or 1))
+        return est * (q // slots + 2)
+
+    def _sticky(self, rec: _Placement, fits: List[_ReplicaState],
+                now: float) -> Optional[_ReplicaState]:
+        if rec.session is None:
+            return None
+        ent = self._sessions.get(rec.session)
+        if ent is None:
+            return None
+        name, expiry = ent
+        if now >= expiry:
+            del self._sessions[rec.session]
+            return None
+        for st in fits:
+            if st.name == name:
+                return st
+        return None
+
+    def _best_prefix(self, fits: List[_ReplicaState], rec: _Placement,
+                     ) -> Tuple[Optional[_ReplicaState], int]:
+        """Longest-cached-prefix scoring: leading digests of the
+        request's chain present in the replica's mirror, in tokens.
+        Only FULL pages strictly before the last prompt token count —
+        the same shareability rule the paged admission applies."""
+        best, best_tokens, best_load = None, 0, 0.0
+        for st in fits:
+            P = int((st.report or {}).get("page_tokens") or 0)
+            chain = rec.chains.get(P)
+            if not P or not chain:
+                continue
+            share = (rec.tokens.size - 1) // P
+            n = 0
+            for d in chain[:share]:
+                if d not in st.digests:
+                    break
+                n += 1
+            tokens = n * P
+            if tokens == 0:
+                continue
+            load = self._load(st)
+            if tokens > best_tokens or (tokens == best_tokens
+                                        and load < best_load):
+                best, best_tokens, best_load = st, tokens, load
+        return best, best_tokens
+
+    def _fallback(self, fits: List[_ReplicaState]) -> _ReplicaState:
+        if len(fits) == 1:
+            return fits[0]
+        if self.policy == "round_robin":
+            self._rr += 1
+            return fits[self._rr % len(fits)]
+        a, b = self._rng.sample(fits, 2)  # power of two choices
+        return a if self._load(a) <= self._load(b) else b
+
+    def _pick(self, rec: _Placement, now: float,
+              exclude=()) -> _ReplicaState:
+        """Choose a replica and record the placement (lock held).
+        Raises via :meth:`_shed_locked` when nothing can take the
+        request."""
+        live = [st for st in self._replicas.values()
+                if st.alive and not st.draining
+                and st.name not in exclude]
+        if not live:
+            self._shed_locked(rec, "capacity", "no live replica")
+        fits = [st for st in live if self._fits(st, rec)]
+        if not fits:
+            self._shed_locked(
+                rec, "capacity",
+                "request (%d prompt + %d new tokens) exceeds every "
+                "replica's budget" % (rec.tokens.size, rec.max_new))
+        if rec.deadline is not None:
+            budget = max(0.0, (rec.deadline - now) * 1e3) * self._slack
+            ok = [st for st in fits if self._eta_ms(st) <= budget]
+            if not ok:
+                self._shed_locked(
+                    rec, "deadline",
+                    "no replica can finish inside %.0f ms"
+                    % ((rec.deadline - now) * 1e3))
+            fits = ok
+        hit_tokens = 0
+        st = self._sticky(rec, fits, now)
+        if st is None and self.policy == "prefix":
+            st, hit_tokens = self._best_prefix(fits, rec)
+        if st is None:
+            st = self._fallback(fits)
+        rec.epoch += 1
+        rec.state = st
+        st.inflight[rec.rid] = rec
+        st.placed += 1
+        P = int((st.report or {}).get("page_tokens") or 0)
+        if P and rec.chains.get(P):
+            # optimistic mirror: the pages this prompt will register
+            st.digests.update(rec.chains[P])
+        if rec.session is not None:
+            self._sessions[rec.session] = (st.name,
+                                           now + self._session_ttl)
+        if hit_tokens:
+            self._prefix_routed += 1
+            telemetry.counter("fleet_routed_prefix_hits_total").inc()
+            telemetry.counter("fleet_prefix_hit_tokens_total").inc(
+                hit_tokens)
+        return st
+
+    # -------------------------------------------------------------- dispatch
+    def _unplace(self, rec: _Placement, st: _ReplicaState) -> None:
+        with self._lock:
+            st.inflight.pop(rec.rid, None)
+            self._lock.notify_all()
+
+    def _dispatch_once(self, rec: _Placement,
+                       st: _ReplicaState) -> bool:
+        """Hand a recorded placement to its replica.  Returns False
+        when the replica rejected synchronously (backpressure, closed)
+        and the caller should re-pick elsewhere; True when dispatched
+        OR terminally settled."""
+        now = time.monotonic()
+        kw = dict(rec.kw)
+        if rec.deadline is not None:
+            remaining = (rec.deadline - now) * 1e3
+            if remaining <= 0:
+                self._unplace(rec, st)
+                self._settle(rec, exc=MXNetError(
+                    "deadline expired before dispatch (%.1f ms in "
+                    "router)" % ((now - rec.t_submit) * 1e3)))
+                return True
+            # the engine enforces the REMAINING budget queue-side
+            kw["deadline_ms"] = remaining
+        with self._lock:
+            epoch = rec.epoch
+        try:
+            efut = st.replica.submit(rec.tokens, rec.max_new, **kw)
+        except Exception as exc:  # noqa: BLE001 — re-picked/settled
+            self._unplace(rec, st)
+            with self._lock:
+                rec.tried.add(st.name)
+                rec.last_exc = exc
+            return False
+        efut.add_done_callback(
+            lambda f, r=rec, e=epoch: self._on_done(r, e, f))
+        return True
+
+    def _route(self, rec: _Placement) -> None:
+        """Re-pick and dispatch until placed or out of candidates
+        (used after dispatch-time rejections and for failover
+        re-routes; failures settle the future, they never raise)."""
+        while True:
+            try:
+                with self._lock:
+                    st = self._pick(rec, time.monotonic(),
+                                    exclude=rec.tried)
+            except MXNetError as exc:
+                self._settle(rec, exc=rec.last_exc or exc)
+                return
+            if self._dispatch_once(rec, st):
+                return
+
+    def _on_done(self, rec: _Placement, epoch: int,
+                 efut: Future) -> None:
+        """Engine-future completion (runs on the replica's loop or
+        reader thread).  Success settles the router future (first
+        settle wins — a late success from a superseded dispatch is
+        still a valid greedy result).  Failure retries on another
+        replica when the request is retryable and the failure belongs
+        to the current dispatch epoch."""
+        exc = efut.exception()
+        if exc is None:
+            self._settle(rec, result=efut.result())
+            return
+        retry = False
+        with self._lock:
+            if rec.done or rec.epoch != epoch:
+                return
+            st = rec.state
+            if st is not None:
+                st.inflight.pop(rec.rid, None)
+                rec.state = None
+                self._lock.notify_all()
+            if rec.retryable and rec.retries_left > 0 \
+                    and not self._closed:
+                rec.retries_left -= 1
+                if st is not None:
+                    rec.tried.add(st.name)
+                rec.last_exc = exc
+                self._retries_n += 1
+                retry = True
+        if not retry:
+            self._settle(rec, exc=exc)
+            return
+        telemetry.counter("fleet_retries_total").inc()
+        self._route(rec)
+
+    def _settle(self, rec: _Placement, result=None, exc=None) -> None:
+        """Resolve the router future exactly once and release the
+        in-flight record (drain waiters are notified)."""
+        with self._lock:
+            if rec.done:
+                return
+            rec.done = True
+            st = rec.state
+            if st is not None:
+                st.inflight.pop(rec.rid, None)
+                rec.state = None
+            self._lock.notify_all()
+        if exc is None:
+            rec.future.set_result(result)
+        else:
+            rec.future.set_exception(exc)
+
+    # --------------------------------------------------------------- health
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self._heartbeat_s):
+            try:
+                self.poll()
+            except Exception:  # noqa: BLE001 — heartbeat must survive
+                continue
+
+    def _probe(self, st: _ReplicaState) -> None:
+        """One load_report round-trip for one replica (no lock held
+        across the call)."""
+        try:
+            report = st.replica.load_report()
+        except Exception as exc:  # noqa: BLE001 — counted as a miss
+            self._redispatch(self._note_miss(st, exc,
+                                             time.monotonic()))
+            return
+        self._redispatch(self._apply_report(st, report))
+
+    def poll(self) -> None:
+        """One synchronous heartbeat sweep over every replica —
+        exactly what the background thread runs each interval, exposed
+        so tests and drains can refresh the mirrors deterministically.
+        """
+        now = time.monotonic()
+        with self._lock:
+            states = list(self._replicas.values())
+            for s in [s for s, (_, exp) in self._sessions.items()
+                      if now >= exp]:
+                del self._sessions[s]
+        for st in states:
+            self._probe(st)
+        with self._lock:
+            alive = sum(1 for s in self._replicas.values() if s.alive)
+        telemetry.gauge("fleet_replicas_alive").set(alive)
+
+    def _note_miss(self, st: _ReplicaState, exc: BaseException,
+                   now: float) -> List[_Placement]:
+        with self._lock:
+            st.misses += 1
+            if st.alive and now - st.last_ok > self._dead_after_s:
+                return self._mark_dead_locked(
+                    st, "no heartbeat for %.1f s (last error: %r)"
+                    % (now - st.last_ok, exc), reroute=True)
+        return []
+
+    def _apply_report(self, st: _ReplicaState,
+                      report: Dict[str, object]) -> List[_Placement]:
+        with self._lock:
+            st.report = report
+            st.last_ok = time.monotonic()
+            st.misses = 0
+            st.placed = 0
+            digests = set(report.get("prefix_digests") or ())
+            P = int(report.get("page_tokens") or 0)
+            if P:
+                # keep the optimistic entries of still-in-flight
+                # prompts: they register their pages on completion
+                for rec in st.inflight.values():
+                    chain = rec.chains.get(P)
+                    if chain:
+                        digests.update(chain)
+            st.digests = digests
+            if report.get("closed") and st.alive:
+                # a closed engine drains its active slots, so the
+                # in-flight futures still resolve — stop placements
+                # but do not re-route what it will finish itself
+                return self._mark_dead_locked(st, "engine closed",
+                                              reroute=False)
+        return []
+
+    def _mark_dead_locked(self, st: _ReplicaState, why: str,
+                          reroute: bool) -> List[_Placement]:
+        """Mark a replica dead (lock held).  Returns the in-flight
+        placements to fail/re-route OUTSIDE the lock."""
+        st.alive = False
+        self._deaths += 1
+        telemetry.counter("fleet_replica_dead_total").inc()
+        for s in [s for s, (n, _) in self._sessions.items()
+                  if n == st.name]:
+            del self._sessions[s]
+        if not reroute:
+            return []
+        recs = list(st.inflight.values())
+        st.inflight.clear()
+        err = MXNetError("replica %r marked dead: %s"
+                         % (st.name, why))
+        for rec in recs:
+            rec.epoch += 1   # invalidate the dead dispatch's callback
+            rec.state = None
+            rec.tried.add(st.name)
+            rec.last_exc = err
+        self._lock.notify_all()
+        return recs
+
+    def _redispatch(self, recs: List[_Placement]) -> None:
+        """Fail-fast or re-route the in-flight of a dead replica."""
+        for rec in recs:
+            retry = False
+            with self._lock:
+                if rec.done:
+                    continue
+                if rec.retryable and rec.retries_left > 0 \
+                        and not self._closed:
+                    rec.retries_left -= 1
+                    self._retries_n += 1
+                    retry = True
+            if not retry:
+                self._settle(rec, exc=rec.last_exc)
+                continue
+            telemetry.counter("fleet_retries_total").inc()
+            self._route(rec)
+
+    # -------------------------------------------------------------- draining
+    def drain(self, replica, timeout: Optional[float] = None) -> float:
+        """Stop new placements on one replica, wait for its in-flight
+        requests to settle, then detach it.  Returns the wall seconds
+        the drain took; raises ``MXNetError`` on timeout (the replica
+        stays attached and draining, so a later drain can finish the
+        job).  The replica object itself is NOT closed — that is the
+        caller's deploy step."""
+        timeout = float(timeout if timeout is not None
+                        else self._drain_timeout)
+        name = replica if isinstance(replica, str) else replica.name
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        with self._lock:
+            st = self._replicas.get(name)
+            if st is None:
+                raise MXNetError("unknown replica %r" % (name,))
+            st.draining = True
+            for s in [s for s, (n, _) in self._sessions.items()
+                      if n == name]:
+                del self._sessions[s]
+            while st.inflight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise MXNetError(
+                        "drain of %r timed out after %.1f s with %d "
+                        "request(s) in flight"
+                        % (name, timeout, len(st.inflight)))
+                self._lock.wait(timeout=min(left, 0.1))
+            del self._replicas[name]
+        dur = time.monotonic() - t0
+        telemetry.histogram("fleet_drain_seconds").observe(dur)
+        return dur
+
+    # ------------------------------------------------------------ lifecycle
+    def describe(self) -> Dict[str, object]:
+        """One consistent snapshot of the router mirrors (tests and
+        the bench read this instead of poking internals)."""
+        with self._lock:
+            return {
+                "replicas": {st.name: {
+                    "alive": st.alive,
+                    "draining": st.draining,
+                    "inflight": len(st.inflight),
+                    "digests": len(st.digests),
+                    "placed_since_report": st.placed,
+                    "report": dict(st.report) if st.report else None,
+                } for st in self._replicas.values()},
+                "sessions": len(self._sessions),
+                "requests": self._n_requests,
+                "prefix_routed": self._prefix_routed,
+                "retries": self._retries_n,
+                "deaths": self._deaths,
+                "shed": dict(self._shed),
+            }
+
+    def close(self, close_replicas: bool = False) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            states = list(self._replicas.values())
+        self._stop.set()
+        self._hb_thread.join(timeout=10)
+        if close_replicas:
+            for st in states:
+                try:
+                    st.replica.close()
+                except Exception:  # noqa: BLE001 — best effort
+                    continue
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

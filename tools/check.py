@@ -38,7 +38,12 @@ Checks, in order:
    A/B bit-equality, torn-save fallback, preemption final save and
    injector determinism (``tests/test_resilience.py``;
    ``TP_CHECK_FAULT=0`` skips);
-11. **static-analysis** — the ``tools/lint.py`` suite (graph verifier
+11. **router** — the fleet-router subset: a 2-replica fleet's greedy
+   tokens bit-identical to a single-replica run with real prefix hits,
+   replica-kill failover losing nothing, and drain-then-detach
+   completing all in-flight work (``tests/test_router.py``;
+   ``TP_CHECK_ROUTER=0`` skips);
+12. **static-analysis** — the ``tools/lint.py`` suite (graph verifier
    over the model zoo, tracing-hazard lint, lock-order checker,
    lockset race detector, env-knob drift incl. documented defaults;
    docs/static_analysis.md): zero unsuppressed findings (needs jax —
@@ -275,6 +280,44 @@ def check_speculative(problems):
                         "failed:\n  " + "\n  ".join(tail))
 
 
+def check_router(problems):
+    """Fleet-router gate (docs/fleet_serving.md): a 2-replica
+    prefix-routed fleet over a Zipf-shared-prefix mixed load emits
+    greedy tokens bit-identical to a single-replica run while the
+    replica pools record real prefix hits; killing a replica mid-burst
+    re-routes its queued work with zero lost futures (still
+    bit-identical); drain completes the in-flight requests then
+    detaches.  The heavy tests carry ``@pytest.mark.slow`` so the
+    tier-1 sweep skips them; this gate runs them by id (needs jax —
+    skip with ``TP_CHECK_ROUTER=0``)."""
+    if os.environ.get("TP_CHECK_ROUTER", "1") == "0":
+        return
+    import subprocess
+
+    tests = "tests/test_router.py"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q",
+             "-p", "no:cacheprovider", "-p", "no:randomly",
+             tests + "::test_fleet_greedy_bitexact_vs_single_replica"
+                     "_with_prefix_hits",
+             tests + "::test_replica_kill_failover_bitexact"
+                     "_no_lost_futures",
+             tests + "::test_drain_completes_inflight_then_detaches",
+             tests + "::test_quota_shedding_at_admission",
+             tests + "::test_deadline_class_shedding"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        problems.append("router: gate run did not finish: %s" % e)
+        return
+    if proc.returncode != 0:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-12:]
+        problems.append("router: fleet-router gate failed:\n  "
+                        + "\n  ".join(tail))
+
+
 def check_overlap(problems):
     """Overlap-equality gate (docs/input_pipeline.md): the bounded
     dispatch window, device staging, and on-device metrics must leave
@@ -412,6 +455,7 @@ def main():
     check_serving(problems)
     check_paged(problems)
     check_speculative(problems)
+    check_router(problems)
     check_overlap(problems)
     check_quant(problems)
     check_resilience(problems)

@@ -39,6 +39,7 @@ LOCK_MODULES = [
     "incubator_mxnet_tpu/serving/generate.py",
     "incubator_mxnet_tpu/serving/paged.py",
     "incubator_mxnet_tpu/serving/speculative.py",
+    "incubator_mxnet_tpu/serving/router.py",
     "incubator_mxnet_tpu/io.py",
     "incubator_mxnet_tpu/resilience/manager.py",
     "incubator_mxnet_tpu/resilience/faults.py",
