@@ -943,6 +943,38 @@ def main():
                              "opt_state_bytes_per_device")}
     combined["opt_state_bytes_per_device"] = \
         lm["opt_state_bytes_per_device"]
+    # Bucketed gradient-collectives A/B (docs/comm_overlap.md): the
+    # same dp mesh with the monolithic all-reduce split into
+    # backward-ordered buckets, then bf16-on-the-wire on top.
+    # TP_LM_GRAD_BUCKET_MB sets the bucket size for the bucketed legs;
+    # it is popped around the runs so the monolithic leg stays the
+    # seed path.  f32-wire bucketing is bit-identical to monolithic
+    # (tools/check.py comm gate), so the legs differ only in issue
+    # structure, wire bytes, and overlap bound.
+    _bmb_env = os.environ.pop("TP_LM_GRAD_BUCKET_MB", None)
+    _wire_env = os.environ.pop("TP_LM_GRAD_COMM_DTYPE", None)
+    bmb = float(_bmb_env if _bmb_env is not None
+                else ("0.02" if small else "25"))
+    try:
+        bkeys = ("value", "grad_comm_buckets", "grad_comm_bytes",
+                 "grad_comm_overlap_fraction", "grad_comm_dtype",
+                 "mesh_dp")
+        bmono = bench_lm.run(defaults=dict(lm_defaults, TP_LM_DP=zdp))
+        bf32 = bench_lm.run(defaults=dict(
+            lm_defaults, TP_LM_DP=zdp, TP_LM_GRAD_BUCKET_MB=bmb))
+        bbf16 = bench_lm.run(defaults=dict(
+            lm_defaults, TP_LM_DP=zdp, TP_LM_GRAD_BUCKET_MB=bmb,
+            TP_LM_GRAD_COMM_DTYPE="bf16"))
+    finally:
+        if _bmb_env is not None:
+            os.environ["TP_LM_GRAD_BUCKET_MB"] = _bmb_env
+        if _wire_env is not None:
+            os.environ["TP_LM_GRAD_COMM_DTYPE"] = _wire_env
+    combined["grad_bucket"] = {
+        "bucket_mb": bmb,
+        "monolithic": {k: bmono[k] for k in bkeys},
+        "bucketed_f32": {k: bf32[k] for k in bkeys},
+        "bucketed_bf16": {k: bbf16[k] for k in bkeys}}
     # MoE row (PERF.md §8e): same flagship step with the expert FFN —
     # driver-captured so the MoE throughput claim has provenance too
     moe = bench_lm.run(defaults=dict(

@@ -26,6 +26,7 @@ from .pipeline import (pipeline_apply, pipeline_parallel_apply,
                        PipelineTrainStep, pp_bubble_fraction,
                        pp_schedule)
 from .pipeline_symbol import SymbolPipelineTrainStep
+from .buckets import BucketPlan, build_plan, param_backward_order
 from .moe import moe_ffn, expert_parallel_moe
 from .vocab_parallel import vocab_parallel_softmax_xent
 from .checkpoint import save_sharded, restore_sharded
@@ -36,5 +37,6 @@ __all__ = ["build_mesh", "default_mesh", "data_parallel_spec",
            "ulysses_attention", "sequence_parallel_attention",
            "pipeline_apply", "pipeline_parallel_apply",
            "PipelineTrainStep", "SymbolPipelineTrainStep",
-           "pp_bubble_fraction", "pp_schedule", "moe_ffn",
+           "pp_bubble_fraction", "pp_schedule", "BucketPlan",
+           "build_plan", "param_backward_order", "moe_ffn",
            "expert_parallel_moe", "save_sharded", "restore_sharded"]

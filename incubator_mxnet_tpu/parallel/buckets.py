@@ -1,0 +1,341 @@
+"""Gradient bucketing + comms/compute overlap (docs/comm_overlap.md).
+
+The PyTorch-DDP / Horovod bucketing insight (Li et al., "PyTorch
+Distributed", VLDB 2020) applied to a GSPMD mesh: instead of ONE
+monolithic gradient all-reduce (or ZeRO reduce-scatter) issued after
+the whole backward pass, the grad pytree is grouped into size-targeted
+buckets in reverse-autodiff order — the order backward *completes*
+gradients — and each bucket's collective is issued as soon as the
+bucket is full, so communication runs concurrent with the remaining
+backward compute instead of after it.
+
+Under jit there is no imperative "issue now": the issue points are
+pinned structurally.  Each bucket's values are threaded through a
+shared ``lax.optimization_barrier`` token before AND after its
+collective, which (a) prevents XLA's all-reduce combiner from merging
+the buckets back into one monolithic collective, and (b) orders the
+buckets on one logical comm stream the way DDP's dedicated NCCL
+stream does.  The collectives themselves use the repo's GSPMD
+spelling (``with_sharding_constraint`` — ``parallel/collectives.py``):
+a replicated constraint resolves the pending dp-sum as an all-reduce;
+a ZeRO state-sharding constraint resolves it as a reduce-scatter.
+
+Bit-equality contract: at f32 wire dtype the bucketed path is
+bit-identical to the monolithic one — barriers are value-identity,
+concat/slice commute with the elementwise psum, and psum of a slice
+equals the slice of the psum.  ``TP_GRAD_COMM_DTYPE=bf16`` opts into
+halving the wire bytes (grads cast to bf16 per bucket, reduced on the
+wire, upcast for the f32 update math) and is therefore only legal
+with bucketing enabled — the monolithic path stays exactly the seed.
+
+Everything in this module is pure planning + trace-time graph
+building; nothing allocates device memory.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import telemetry
+
+__all__ = ["Bucket", "BucketPlan", "param_backward_order",
+           "plan_buckets", "build_plan", "segment_bounds",
+           "bucketed_reduce", "bucketed_psum", "resolve_comm_knobs"]
+
+
+def resolve_comm_knobs(grad_bucket_mb, grad_comm_dtype):
+    """Resolve the shared (bucket size, wire dtype) knob pair.
+
+    Explicit arguments win; ``None`` falls back to ``TP_GRAD_BUCKET_MB``
+    (MiB per bucket, 0 = monolithic seed path) and ``TP_GRAD_COMM_DTYPE``
+    (e.g. ``bf16``; unset/f32 = reduce at the grad's own dtype).  A wire
+    dtype without bucketing is rejected: the monolithic reduction is
+    contractually bit-identical to the seed, so compression may only
+    ride the bucketed scheduler.  Returns ``(bucket_mb, np dtype|None)``.
+    """
+    from ..base import MXNetError, dtype_np, get_env
+
+    if grad_bucket_mb is None:
+        grad_bucket_mb = float(get_env("GRAD_BUCKET_MB", 0, float))
+    bucket_mb = float(grad_bucket_mb)
+    if bucket_mb < 0:
+        raise MXNetError("grad_bucket_mb must be >= 0")
+    if grad_comm_dtype is None:
+        grad_comm_dtype = get_env("GRAD_COMM_DTYPE") or None
+    if grad_comm_dtype in ("float32", "f32"):
+        grad_comm_dtype = None
+    if grad_comm_dtype == "bf16":
+        grad_comm_dtype = "bfloat16"
+    comm_dtype = dtype_np(grad_comm_dtype) if grad_comm_dtype else None
+    if comm_dtype is not None and not bucket_mb:
+        raise MXNetError(
+            "grad_comm_dtype=%r requires grad bucketing "
+            "(grad_bucket_mb / TP_GRAD_BUCKET_MB > 0): the monolithic "
+            "reduction stays bit-identical to the unbucketed path"
+            % (grad_comm_dtype,))
+    return bucket_mb, comm_dtype
+
+# one bucket: param names (issue order within is irrelevant — they
+# share a single pinned issue point), total elements, wire bytes
+Bucket = namedtuple("Bucket", ["names", "elems", "bytes"])
+
+
+def param_backward_order(symbol, param_names: Sequence[str]) -> \
+        List[str]:
+    """``param_names`` sorted by when backward COMPLETES their grad.
+
+    A parameter's gradient is finished once the backward sweep has
+    processed every consumer of the parameter; backward walks the topo
+    order in reverse, so the grad completes when it passes the
+    parameter's EARLIEST consumer.  Sorting by descending min-consumer
+    position therefore yields grads in completion order — the order
+    buckets should fill and issue.  Params with no consumer (dead
+    inputs) sort last; ties keep declaration order for determinism.
+    """
+    nodes = symbol.topo_nodes()
+    pos = {}
+    compute_pos = 0
+    first_use: Dict[str, int] = {}
+    for node in nodes:
+        if node.is_variable:
+            continue
+        for inp, _ in node.inputs:
+            if inp.is_variable and inp.name not in first_use:
+                first_use[inp.name] = compute_pos
+        compute_pos += 1
+    for i, n in enumerate(param_names):
+        pos[n] = (-first_use.get(n, -1), i)
+    return sorted(param_names, key=lambda n: pos[n])
+
+
+def plan_buckets(items: Sequence[Tuple[str, int]], bucket_bytes: int,
+                 itemsize: int) -> List[List[Tuple[str, int]]]:
+    """Greedy size-targeted grouping of ``(name, elems)`` items.
+
+    Items are taken in the given (backward-completion) order; a bucket
+    closes once it holds >= ``bucket_bytes`` of payload at ``itemsize``
+    bytes per element.  One oversized tensor gets a bucket of its own
+    (DDP semantics — a bucket is never split below tensor granularity).
+    """
+    if bucket_bytes <= 0:
+        return [list(items)] if items else []
+    buckets: List[List[Tuple[str, int]]] = []
+    cur: List[Tuple[str, int]] = []
+    cur_bytes = 0
+    for name, elems in items:
+        cur.append((name, int(elems)))
+        cur_bytes += int(elems) * itemsize
+        if cur_bytes >= bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def segment_bounds(total_elems: int, bucket_mb: float,
+                   itemsize: int) -> List[Tuple[int, int]]:
+    """Split a flat length into contiguous ``(lo, hi)`` segments of
+    ~``bucket_mb`` each — the pipeline step's flat (maxP,) grad row has
+    no per-tensor boundaries worth respecting, so plain chunking is
+    the bucket plan there."""
+    if total_elems <= 0:
+        return []
+    if bucket_mb <= 0:
+        return [(0, total_elems)]
+    per = max(int(bucket_mb * (1 << 20) / itemsize), 1)
+    return [(lo, min(lo + per, total_elems))
+            for lo in range(0, total_elems, per)]
+
+
+class BucketPlan:
+    """The static plan: bucket composition, wire dtype, byte totals.
+
+    ``overlap_fraction`` is the plan-level overlap bound: every bucket
+    except the LAST-issued one has remaining backward compute to hide
+    behind, so ``(total - last_bucket) / total`` of the wire bytes are
+    overlappable.  (On the CPU test mesh XLA runs collectives inline,
+    so this is the structural number the plan guarantees, not a
+    measured timeline — see docs/comm_overlap.md.)
+    """
+
+    def __init__(self, buckets: Sequence[Bucket], wire_dtype,
+                 bucket_mb: float, kind: str):
+        self.buckets = tuple(buckets)
+        self.wire_dtype = np.dtype(wire_dtype)
+        self.bucket_mb = float(bucket_mb)
+        self.kind = kind  # "all_reduce" | "reduce_scatter" | "psum"
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.buckets)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(b.bytes for b in self.buckets)
+
+    @property
+    def overlap_fraction(self) -> float:
+        total = self.total_bytes
+        if total <= 0 or len(self.buckets) < 2:
+            return 0.0
+        return (total - self.buckets[-1].bytes) / float(total)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"buckets": self.num_buckets,
+                "bucket_mb": self.bucket_mb,
+                "wire_dtype": self.wire_dtype.name,
+                "kind": self.kind,
+                "grad_comm_bytes": self.total_bytes,
+                "overlap_fraction": round(self.overlap_fraction, 4),
+                "bucket_bytes": [b.bytes for b in self.buckets]}
+
+    def report(self) -> str:
+        """Human-readable plan dump (the dryrun prints this)."""
+        lines = ["grad bucket plan: %d bucket(s), %s wire, %s, "
+                 "%.2f MiB total, overlap bound %.0f%%"
+                 % (self.num_buckets, self.wire_dtype.name, self.kind,
+                    self.total_bytes / float(1 << 20),
+                    100.0 * self.overlap_fraction)]
+        for i, b in enumerate(self.buckets):
+            head = ", ".join(b.names[:3])
+            if len(b.names) > 3:
+                head += ", … +%d" % (len(b.names) - 3)
+            lines.append("  bucket %d: %7.3f MiB  %d tensor(s)  [%s]"
+                         % (i, b.bytes / float(1 << 20), len(b.names),
+                            head))
+        return "\n".join(lines)
+
+    def publish(self, scope: str) -> None:
+        """Expose the plan through the telemetry registry."""
+        if not telemetry.enabled():
+            return
+        lab = {"scope": scope}
+        telemetry.counter("grad_comm_buckets_total", lab).inc(
+            self.num_buckets)
+        telemetry.counter("grad_comm_bytes", lab).inc(self.total_bytes)
+        telemetry.gauge("grad_comm_overlap_fraction", lab).set(
+            self.overlap_fraction)
+
+
+def build_plan(items: Sequence[Tuple[str, int]], bucket_mb: float,
+               wire_dtype, kind: str) -> BucketPlan:
+    """Plan buckets over ``(name, elems)`` items already in backward-
+    completion order.  ``bucket_mb <= 0`` plans the monolithic single
+    bucket (reporting-only — the caller keeps the unbucketed path)."""
+    wire = np.dtype(wire_dtype)
+    groups = plan_buckets(items, int(bucket_mb * (1 << 20)),
+                          wire.itemsize)
+    buckets = [Bucket(tuple(n for n, _ in g),
+                      sum(e for _, e in g),
+                      sum(e for _, e in g) * wire.itemsize)
+               for g in groups]
+    return BucketPlan(buckets, wire, bucket_mb, kind)
+
+
+# ---------------------------------------------------------------------------
+# trace-time schedulers
+# ---------------------------------------------------------------------------
+
+
+def _chain(vals, token):
+    """Pin an issue point: thread ``vals`` and the comm-stream token
+    through ONE optimization_barrier, so XLA can neither sink these
+    values past the barrier nor merge collectives across it."""
+    from jax import lax
+
+    flat = list(vals) + [token]
+    flat = lax.optimization_barrier(tuple(flat))
+    return list(flat[:-1]), flat[-1]
+
+
+def bucketed_reduce(grads: Dict[str, Any], plan: BucketPlan,
+                    grad_sharding: Dict[str, Any],
+                    zero_names=frozenset(),
+                    state_sharding: Optional[Dict[str, Any]] = None,
+                    comm_dtype=None) -> Dict[str, Any]:
+    """Issue one pinned collective group per bucket over a grad dict
+    (the ``FusedTrainStep`` path; runs inside jit tracing).
+
+    Per bucket, in plan (= backward-completion) order: grads cast to
+    the wire dtype, barrier-pinned, then resolved per tensor — names
+    in ``zero_names`` reduce-scatter into their ZeRO state sharding
+    (``state_sharding[name]``), everything else all-reduces via the
+    grad's own sharding constraint (replicated params → plain
+    all-reduce; tp/ep-sharded params keep their placement).  The
+    tensors of one bucket sit between the same two barriers, so XLA's
+    all-reduce combiner may fuse them into ONE collective but can
+    never merge across buckets.  Deliberately NOT concatenated by
+    hand: per-tensor collectives keep every downstream fusion shape
+    identical to the monolithic program, which is what makes the f32
+    wire path bit-identical.  Returned grads stay in the wire dtype;
+    the optimizer upcasts.
+    """
+    import jax.numpy as jnp
+
+    from .collectives import (all_reduce_constraint,
+                              reduce_scatter_constraint)
+
+    out: Dict[str, Any] = {}
+    token = jnp.zeros((), jnp.float32)
+    for bucket in plan.buckets:
+        wire = []
+        for n in bucket.names:
+            g = grads[n]
+            if comm_dtype is not None and g.dtype != comm_dtype:
+                g = g.astype(comm_dtype)
+            wire.append(g)
+        wire, token = _chain(wire, token)
+        reduced = []
+        for n, g in zip(bucket.names, wire):
+            if n in zero_names:
+                reduced.append(reduce_scatter_constraint(
+                    g, state_sharding[n]))
+            else:
+                reduced.append(all_reduce_constraint(
+                    g, grad_sharding[n]))
+        reduced, token = _chain(reduced, token)
+        out.update(zip(bucket.names, reduced))
+    return out
+
+
+def bucketed_psum(vec, bounds: Sequence[Tuple[int, int]], axis_names,
+                  comm_dtype=None):
+    """Segment-bucketed ``lax.psum`` of a flat grad row (the
+    ``SymbolPipelineTrainStep`` path; runs inside shard_map tracing).
+
+    Issue order is DESCENDING offset: the flat row packs params in
+    topo order, so high offsets belong to late-forward layers whose
+    grads complete first in backward.  psum of a slice == slice of the
+    psum, so at f32 wire this is bit-identical to one monolithic psum.
+
+    The reduced segments are stitched back with dynamic_update_slice
+    rather than concatenate: XLA's instruction fusion pulls a
+    concatenate INTO the downstream optimizer-update loop fusion,
+    which changes its codegen (and hence FMA contraction) relative to
+    the monolithic program's single-psum parameter — 1-ulp drift that
+    breaks the bit-equality contract.  The DUS chain stays outside the
+    update fusion, so the update consumes one contiguous buffer with
+    the exact fusion shape of the unbucketed program.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .collectives import all_reduce
+
+    if len(bounds) <= 1 and comm_dtype is None:
+        return all_reduce(vec, axis_names)
+    token = jnp.zeros((), jnp.float32)
+    out = jnp.zeros(vec.shape, vec.dtype)
+    for i in range(len(bounds) - 1, -1, -1):
+        lo, hi = bounds[i]
+        seg = vec[lo:hi]
+        if comm_dtype is not None:
+            seg = seg.astype(comm_dtype)
+        (seg,), token = _chain([seg], token)
+        seg = all_reduce(seg, axis_names)
+        (seg,), token = _chain([seg], token)
+        out = lax.dynamic_update_slice(out, seg.astype(vec.dtype), (lo,))
+    return out

@@ -13,17 +13,21 @@ from .. import telemetry
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "ring_permute",
            "barrier_sync", "reduce_scatter_constraint",
-           "all_gather_constraint"]
+           "all_gather_constraint", "all_reduce_constraint"]
 
 _KIND_LABELS = {}
 
 
-def _count(kind: str, x) -> None:
-    """Record one collective invocation + its per-shard payload bytes.
+def _count(kind: str, x, nbytes: Optional[int] = None) -> None:
+    """Record one collective invocation + its payload bytes.
 
     These wrappers run inside jit/shard_map *tracing*, so counts are
     trace-time (once per compiled program), not per-execution — still the
     right signal for "what collectives does this model build, and how big".
+    Bytes are counted at the value's ACTUAL element dtype (a bf16 grad
+    on the wire is 2 bytes/elem, not its f32 master width); callers that
+    know a tighter wire payload (reduce_scatter's per-shard output) pass
+    ``nbytes`` explicitly.
     """
     if not telemetry.enabled():
         return
@@ -34,11 +38,12 @@ def _count(kind: str, x) -> None:
     try:
         import numpy as np
 
-        size = 1
-        for s in x.shape:
-            size *= int(s)
-        telemetry.counter("collective_bytes_total", lab).inc(
-            size * np.dtype(x.dtype).itemsize)
+        if nbytes is None:
+            size = 1
+            for s in x.shape:
+                size *= int(s)
+            nbytes = size * np.dtype(x.dtype).itemsize
+        telemetry.counter("collective_bytes_total", lab).inc(nbytes)
     except (TypeError, ValueError, AttributeError):
         pass
 
@@ -61,10 +66,43 @@ def all_gather(x, axis_name: str = "dp", axis: int = 0, tiled: bool = True):
 def reduce_scatter(x, axis_name: str = "dp", scatter_dimension: int = 0):
     import jax
 
-    _count("reduce_scatter", x)
+    _count("reduce_scatter", x, _scatter_bytes(x, axis_name))
     return jax.lax.psum_scatter(x, axis_name,
                                 scatter_dimension=scatter_dimension,
                                 tiled=True)
+
+
+def _scatter_bytes(x, axis_name) -> Optional[int]:
+    """Per-shard OUTPUT bytes of a shard_map reduce-scatter: each
+    device receives 1/axis_size of the input elements."""
+    try:
+        import numpy as np
+
+        from .mesh import axis_size as _axis_size
+
+        size = 1
+        for s in x.shape:
+            size *= int(s)
+        n = int(_axis_size(axis_name)) if isinstance(axis_name, str) \
+            else int(np.prod([_axis_size(a) for a in axis_name]))
+        return size * np.dtype(x.dtype).itemsize // max(n, 1)
+    except Exception:
+        return None
+
+
+def _shard_out_bytes(x, sharding) -> Optional[int]:
+    """Per-shard OUTPUT bytes of a constraint-spelled reduce-scatter:
+    what one device actually receives under ``sharding``."""
+    try:
+        import numpy as np
+
+        shard = sharding.shard_shape(tuple(int(s) for s in x.shape))
+        size = 1
+        for s in shard:
+            size *= int(s)
+        return size * np.dtype(x.dtype).itemsize
+    except Exception:
+        return None
 
 
 def reduce_scatter_constraint(x, sharding):
@@ -74,10 +112,22 @@ def reduce_scatter_constraint(x, sharding):
     the slice into ONE reduce-scatter, so each device receives only the
     shard it owns — 1/dp of the all-reduce bytes.  Runs inside pjit
     tracing; counted once per compiled program like the shard_map
-    wrappers above."""
+    wrappers above, at the per-shard output size."""
     import jax
 
-    _count("reduce_scatter", x)
+    _count("reduce_scatter", x, _shard_out_bytes(x, sharding))
+    return jax.lax.with_sharding_constraint(x, sharding)
+
+
+def all_reduce_constraint(x, sharding):
+    """GSPMD spelling of an all-reduce: force a value carrying a
+    pending data-axis sum into its (usually replicated) target layout —
+    XLA resolves the pending psum as ONE all-reduce at exactly this
+    point.  The pinned issue points of the bucketed gradient scheduler
+    (``parallel/buckets.py``) are built from this."""
+    import jax
+
+    _count("all_reduce", x)
     return jax.lax.with_sharding_constraint(x, sharding)
 
 

@@ -179,7 +179,9 @@ class FusedTrainStep:
                  grad_accum: Optional[int] = None,
                  opt_state_dtype=None, grad_dtype=None,
                  shard_optimizer: Optional[bool] = None,
-                 metrics=None, matmul_dtype=None):
+                 metrics=None, matmul_dtype=None,
+                 grad_bucket_mb: Optional[float] = None,
+                 grad_comm_dtype=None):
         import jax
         import jax.numpy as jnp
 
@@ -213,6 +215,19 @@ class FusedTrainStep:
         # remaining headroom named by round-4 verdict #5).  Update math
         # still upcasts to the master dtype; opt-in, None = f32.
         self._grad_dtype = dtype_np(grad_dtype) if grad_dtype else None
+        # gradient bucketing + comms overlap (parallel/buckets.py,
+        # docs/comm_overlap.md): >0 groups the grad pytree into
+        # ~MB-sized buckets in backward-completion order and pins one
+        # collective group per bucket, so the dp all-reduce / ZeRO
+        # reduce-scatter overlaps the remaining backward compute; the
+        # optional wire dtype (bf16) halves the bytes on the wire.
+        # 0 (default) keeps the seed's monolithic reduction untouched.
+        # The TP_GRAD_BUCKET_MB / TP_GRAD_COMM_DTYPE envs apply only
+        # when the caller did not specify.
+        from .buckets import resolve_comm_knobs
+
+        self._bucket_mb, self._comm_dtype = resolve_comm_knobs(
+            grad_bucket_mb, grad_comm_dtype)
         # fp8 matmul path (docs/quantization.md): every FullyConnected
         # matmul runs through quant.scaled_dot — e4m3 fwd / e5m2 bwd
         # casts with delayed per-tensor amax scaling; masters, grads
@@ -323,6 +338,14 @@ class FusedTrainStep:
         if flat_optimizer and opt_state_dtype:
             raise MXNetError("flat_optimizer is incompatible with "
                              "opt_state_dtype")
+        # the flat update consumes ONE concatenated grad buffer; feeding
+        # it per-bucket collective outputs changes its fusion shapes,
+        # which breaks the bucketed path's bit-equality contract
+        # (docs/comm_overlap.md) — reject rather than silently drift
+        if flat_optimizer and self._bucket_mb:
+            raise MXNetError("flat_optimizer is incompatible with "
+                             "grad bucketing (grad_bucket_mb / "
+                             "TP_GRAD_BUCKET_MB)")
         self._flat_opt = bool(flat_optimizer)
         # ZeRO-1 optimizer-state sharding (parallel/zero.py): each
         # param's state lives split over the dp (and, composing with
@@ -373,6 +396,22 @@ class FusedTrainStep:
                     self._state_sharding[n] = jax.sharding.NamedSharding(
                         self.mesh, zspec)
                     self._zero_names.add(n)
+
+        # static bucket plan (built even at bucket_mb=0 so bench /
+        # dryrun always have the byte + overlap report; the monolithic
+        # single bucket is reporting-only and the step keeps the
+        # unbucketed graph)
+        from .buckets import build_plan, param_backward_order
+
+        wire = self._comm_dtype or self._grad_dtype \
+            or np.dtype(np.float32)
+        order = param_backward_order(symbol, self.param_names)
+        items = [(n, int(np.prod(shape_of[n])) if shape_of[n] else 1)
+                 for n in order]
+        self._bucket_plan = build_plan(
+            items, self._bucket_mb, wire,
+            "reduce_scatter" if self._zero else "all_reduce")
+        self._bucket_plan.publish("fused")
 
         var_attrs = {node.name: (node.attrs or {})
                      for node in symbol.topo_nodes() if node.is_variable}
@@ -533,6 +572,9 @@ class FusedTrainStep:
         zero_names = frozenset(self._zero_names)
         state_sharding = dict(self._state_sharding)
         param_sharding = dict(self._param_sharding)
+        bucketed = self._bucket_mb > 0
+        bucket_plan = self._bucket_plan
+        comm_dtype = self._comm_dtype
 
         adam_b1 = float(opt_attrs.get("beta1", 0.9))
         adam_b2 = float(opt_attrs.get("beta2", 0.999))
@@ -638,6 +680,20 @@ class FusedTrainStep:
                 outs = [restack(o, s) for o, s in
                         zip(outs_stacked, self._out_shapes)]
 
+            if bucketed:
+                # issue one pinned collective per bucket, in backward-
+                # completion order, AFTER the accumulation scan — the
+                # reduction happens once, on the summed (last-
+                # microbatch) grads.  ZeRO params land reduce-scattered
+                # straight into their state sharding at wire dtype.
+                from .buckets import bucketed_reduce
+
+                grads = bucketed_reduce(
+                    grads, bucket_plan, param_sharding,
+                    zero_names=zero_names,
+                    state_sharding=state_sharding,
+                    comm_dtype=comm_dtype)
+
             attrs = dict(opt_attrs, lr=lr)
             new_params, new_states = {}, {}
             if self._flat_opt:
@@ -670,7 +726,7 @@ class FusedTrainStep:
                         off += size
             else:
                 for name, w in params.items():
-                    g = grads[name].astype(w.dtype)
+                    g = grads[name]
                     # low-precision stored states: upcast for the
                     # update math, downcast on store
                     sts = [s.astype(w.dtype) for s in opt_states[name]]
@@ -678,10 +734,16 @@ class FusedTrainStep:
                         # ZeRO-1: the pending dp-sum gradient lands
                         # reduce-scattered in the state layout, the
                         # update runs on the owned shard only, and the
-                        # new param all-gathers back to its placement
+                        # new param all-gathers back to its placement.
+                        # The scatter takes the grad at its WIRE dtype
+                        # (before the master upcast) so bf16 grads
+                        # move 1/dp of their bf16 — not f32 — bytes;
+                        # bucketed grads arrived already scattered.
                         ssh = state_sharding[name]
-                        g = reduce_scatter_constraint(g, ssh)
+                        if not bucketed:
+                            g = reduce_scatter_constraint(g, ssh)
                         w = jax.lax.with_sharding_constraint(w, ssh)
+                    g = g.astype(w.dtype)
                     res, _ = opt_op.apply([w, g] + sts,
                                           attrs, OpContext(is_train=True))
                     if name in zero_names:
@@ -864,6 +926,15 @@ class FusedTrainStep:
         from .zero import publish_state_gauges
 
         return publish_state_gauges(self.opt_states, "fused")
+
+    # ------------------------------------------------------------ buckets
+    def bucket_plan(self):
+        """The static gradient-comm :class:`~.buckets.BucketPlan` —
+        per-bucket bytes, wire dtype, overlap bound (``.report()`` for
+        the human dump, ``.to_dict()`` for bench records).  Always
+        present; at ``grad_bucket_mb=0`` it describes the monolithic
+        single-bucket reduction the step actually runs."""
+        return self._bucket_plan
 
     # ------------------------------------------------------------- params
     def get_params(self):

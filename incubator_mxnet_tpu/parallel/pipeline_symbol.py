@@ -307,7 +307,9 @@ class SymbolPipelineTrainStep:
                  initializer=None, seed: int = 0,
                  shard_optimizer: Optional[bool] = None,
                  schedule: Optional[str] = None,
-                 async_loss: bool = False):
+                 async_loss: bool = False,
+                 grad_bucket_mb: Optional[float] = None,
+                 grad_comm_dtype=None):
         import jax
 
         from ..optimizer import fused_update_plan as _fused_update_plan
@@ -407,6 +409,27 @@ class SymbolPipelineTrainStep:
             self._plan["max_psize"] = \
                 -(-self._plan["max_psize"] // ndp) * ndp
 
+        # gradient bucketing over the dp grad psum (parallel/buckets.py,
+        # docs/comm_overlap.md): the flat (maxP,) grad row is reduced in
+        # ~MB-sized contiguous segments, issued highest-offset first
+        # (late-forward layers complete their grads first in backward)
+        # and barrier-pinned so each segment's collective overlaps the
+        # remaining backward ticks.  0 (default) keeps the monolithic
+        # psum.  Planned AFTER the ZeRO pad so bounds cover the real row.
+        from .buckets import (build_plan, resolve_comm_knobs,
+                              segment_bounds)
+
+        self._bucket_mb, self._comm_dtype = resolve_comm_knobs(
+            grad_bucket_mb, grad_comm_dtype)
+        wire = self._comm_dtype or np.dtype(np.float32)
+        self._bucket_bounds = segment_bounds(
+            self._plan["max_psize"], self._bucket_mb, wire.itemsize)
+        self._bucket_plan = build_plan(
+            [("flat[%d:%d)" % (lo, hi), hi - lo)
+             for lo, hi in self._bucket_bounds],
+            self._bucket_mb, wire, "psum")
+        self._bucket_plan.publish("pipeline")
+
         # ---- parameters: per-stage flat rows, on-chip init -----------
         from ..initializer import InitDesc, Uniform
 
@@ -504,6 +527,9 @@ class SymbolPipelineTrainStep:
         L, M = self._L, self._M
         axis = self.axis_name
         data_axes = self._data_axes
+        bucket_bounds = self._bucket_bounds \
+            if self._bucket_mb > 0 else None
+        comm_dtype = self._comm_dtype
         maxB = plan["max_boundary"]
         maxP = plan["max_psize"]
         maxA = plan["max_asize"]
@@ -681,7 +707,16 @@ class SymbolPipelineTrainStep:
             # microbatch — psum over everything reassembles the batch
             losses = lax.psum(losses, (axis,) + data_axes)
             if data_axes:
-                grad = lax.psum(grad, data_axes)
+                if bucket_bounds is not None:
+                    # segment-bucketed dp reduction, pinned issue
+                    # points (docs/comm_overlap.md); psum of a slice
+                    # == slice of the psum, so f32 wire is bit-equal
+                    from .buckets import bucketed_psum
+
+                    grad = bucketed_psum(grad, bucket_bounds,
+                                         data_axes, comm_dtype)
+                else:
+                    grad = lax.psum(grad, data_axes)
                 # BN-style aux updates come from LOCAL dp-shard stats
                 # (per-device BN, the reference's semantics); average
                 # them so the replicated-over-dp output is well-defined
@@ -837,6 +872,14 @@ class SymbolPipelineTrainStep:
         from .zero import publish_state_gauges
 
         return publish_state_gauges(list(self.opt_states), "pipeline")
+
+    # ---------------------------------------------------------- buckets
+    def bucket_plan(self):
+        """The static gradient-comm :class:`~.buckets.BucketPlan` for
+        the flat (maxP,) grad row's dp reduction — per-segment bytes,
+        wire dtype, overlap bound.  At ``grad_bucket_mb=0`` it
+        describes the monolithic single psum the step actually runs."""
+        return self._bucket_plan
 
     # ----------------------------------------------------------- params
     def get_params(self):

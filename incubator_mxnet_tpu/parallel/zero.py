@@ -14,7 +14,10 @@ forced into the state layout (XLA lowers the dp psum + slice into a
 reduce-scatter), the elementwise update runs on the owned shard only,
 and the updated parameter is forced back to its replicated/param layout
 (an all-gather).  See ``collectives.reduce_scatter_constraint`` /
-``all_gather_constraint`` and ``docs/zero.md``.
+``all_gather_constraint`` and ``docs/zero.md``.  Under gradient
+bucketing (``parallel/buckets.py``, docs/comm_overlap.md) the same
+reduce-scatters issue per bucket in backward-completion order — the
+state layouts planned here double as the buckets' scatter targets.
 
 Everything here is pure planning — specs and byte math — so it is also
 usable at pod-scale shapes without allocating anything (the dryrun

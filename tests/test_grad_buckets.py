@@ -1,0 +1,340 @@
+"""Bucketed gradient collectives (``parallel/buckets.py``,
+docs/comm_overlap.md).
+
+Contracts under test on the 8-virtual-device CPU mesh:
+
+- f32-wire bucketed training is BIT-identical to the monolithic seed
+  path — fused + pipeline steps, ZeRO on/off, grad-accum >= 1 (the
+  tools/check.py comm gate runs the same assertions);
+- bf16-on-the-wire composes with ZeRO + grad-accum inside a loss
+  envelope of the f32-wire run, at half the planned wire bytes;
+- the planner fills buckets in backward-completion order to the
+  size target and reports the structural overlap bound;
+- collectives telemetry counts wire bytes at the ACTUAL element
+  dtype, and reduce-scatter spellings count per-shard output bytes.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import parallel, telemetry
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.parallel import (FusedTrainStep,
+                                          SymbolPipelineTrainStep)
+from incubator_mxnet_tpu.parallel.buckets import (param_backward_order,
+                                                  plan_buckets,
+                                                  build_plan,
+                                                  resolve_comm_knobs,
+                                                  segment_bounds)
+
+OPTS = [("sgd", {"learning_rate": 0.2, "momentum": 0.9}),
+        ("adam", {"learning_rate": 0.01})]
+
+
+def _mlp(layers=3, hidden=16, classes=5, indim=12):
+    x = mx.sym.Variable("data")
+    for i in range(layers):
+        x = mx.sym.FullyConnected(x, num_hidden=hidden, name="fc%d" % i)
+        x = mx.sym.Activation(x, act_type="relu", name="relu%d" % i)
+    x = mx.sym.FullyConnected(x, num_hidden=classes, name="out")
+    return mx.sym.SoftmaxOutput(x, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def _batches(n=3, batch=16, indim=12, classes=5, seed=3):
+    rng = np.random.RandomState(seed)
+    return [{"data": rng.randn(batch, indim).astype(np.float32),
+             "softmax_label": rng.randint(0, classes, batch)
+             .astype(np.float32)} for _ in range(n)]
+
+
+def _fused(opt, oparams, zero, bucket_mb=0.0, accum=1, **kw):
+    mx.random.seed(11)
+    mesh = parallel.build_mesh({"dp": 8})
+    return FusedTrainStep(
+        _mlp(), {"data": (16, 12)}, {"softmax_label": (16,)},
+        mesh=mesh, optimizer=opt, optimizer_params=dict(oparams),
+        initializer=mx.initializer.Xavier(), shard_optimizer=zero,
+        grad_accum=accum, grad_bucket_mb=bucket_mb, **kw)
+
+
+def _pipe(opt, oparams, zero, bucket_mb=0.0, **kw):
+    mx.random.seed(11)
+    mesh = parallel.build_mesh({"pp": 2, "dp": 4})
+    return SymbolPipelineTrainStep(
+        _mlp(), {"data": (16, 12)}, {"softmax_label": (16,)},
+        mesh=mesh, num_microbatches=2, optimizer=opt,
+        optimizer_params=dict(oparams),
+        initializer=mx.initializer.Xavier(), shard_optimizer=zero,
+        grad_bucket_mb=bucket_mb, **kw)
+
+
+# ---------------------------------------------------------------------------
+# bit-equality: f32-wire bucketed == monolithic, bit for bit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("zero", [False, True], ids=["dp", "zero"])
+@pytest.mark.parametrize("accum", [1, 2], ids=["accum1", "accum2"])
+@pytest.mark.parametrize("opt,oparams", OPTS, ids=[o[0] for o in OPTS])
+def test_fused_bucketed_bit_identical(opt, oparams, zero, accum):
+    params = {}
+    for mb in (0.0, 0.001):
+        step = _fused(opt, oparams, zero, bucket_mb=mb, accum=accum)
+        for b in _batches():
+            step(b)
+        params[mb] = {k: np.asarray(v) for k, v in step.params.items()}
+    plan = step.bucket_plan()
+    assert plan.num_buckets >= 2
+    assert plan.kind == ("reduce_scatter" if zero else "all_reduce")
+    for k in params[0.0]:
+        a, b = params[0.0][k], params[0.001][k]
+        assert np.array_equal(a, b), \
+            "%s diverged: max|d|=%g" % (k, np.abs(a - b).max())
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("zero", [False, True], ids=["dp", "zero"])
+@pytest.mark.parametrize("opt,oparams", OPTS, ids=[o[0] for o in OPTS])
+def test_pipeline_bucketed_bit_identical(opt, oparams, zero):
+    flat = {}
+    for mb in (0.0, 0.0005):
+        step = _pipe(opt, oparams, zero, bucket_mb=mb)
+        for b in _batches():
+            step(b)
+        flat[mb] = np.asarray(step.flat_params)
+    assert step.bucket_plan().num_buckets >= 2
+    a, b = flat[0.0], flat[0.0005]
+    assert np.array_equal(a, b), \
+        "pipeline diverged: max|d|=%g" % np.abs(a - b).max()
+
+
+# ---------------------------------------------------------------------------
+# bf16 wire x ZeRO x grad-accum: loss envelope, half the planned bytes
+# ---------------------------------------------------------------------------
+
+
+def test_fused_bucketed_bit_identical_smoke():
+    """Tier-1 fast path: one executed bucketed-vs-monolithic combo;
+    the @slow sweep above (and the tools/check.py comm gate) covers
+    the full opt x ZeRO x accum matrix."""
+    params = {}
+    for mb in (0.0, 0.001):
+        step = _fused("sgd", {"learning_rate": 0.2, "momentum": 0.9},
+                      False, bucket_mb=mb)
+        for b in _batches():
+            step(b)
+        params[mb] = {k: np.asarray(v) for k, v in step.params.items()}
+    assert step.bucket_plan().num_buckets >= 2
+    for k in params[0.0]:
+        assert np.array_equal(params[0.0][k], params[0.001][k]), k
+
+
+@pytest.mark.slow
+def test_bf16_wire_zero_accum_envelope():
+    batches = _batches(1)
+    nll = {}
+    plans = {}
+    for wire, gdt in ((None, None), ("bf16", None),
+                      ("bf16", "bfloat16")):
+        step = _fused("adam", {"learning_rate": 0.01}, True,
+                      bucket_mb=0.001, accum=2, grad_comm_dtype=wire,
+                      grad_dtype=gdt)
+        for _ in range(20):
+            outs = step(batches[0])
+        probs = np.asarray(outs[0])
+        lab = batches[0]["softmax_label"].astype(int)
+        nll[(wire, gdt)] = -np.log(
+            probs[np.arange(16), lab] + 1e-9).mean()
+        plans[(wire, gdt)] = step.bucket_plan()
+    base = nll[(None, None)]
+    assert nll[("bf16", None)] < 1.2 * base + 0.05, nll
+    assert nll[("bf16", "bfloat16")] < 1.3 * base + 0.1, nll
+    # bf16 wire halves the planned bytes of the same bucket layout
+    assert plans[("bf16", None)].total_bytes * 2 == \
+        plans[(None, None)].total_bytes
+
+
+# ---------------------------------------------------------------------------
+# planner units
+# ---------------------------------------------------------------------------
+
+
+def test_param_backward_order_is_completion_order():
+    sym = _mlp()
+    names = [n for n in sym.list_arguments()
+             if n not in ("data", "softmax_label")]
+    order = param_backward_order(sym, names)
+    assert sorted(order) == sorted(names)
+    # the head's grads complete first in backward, the stem's last
+    assert order.index("out_weight") < order.index("fc2_weight")
+    assert order.index("fc2_weight") < order.index("fc0_weight")
+    assert order[-1] in ("fc0_weight", "fc0_bias")
+
+
+def test_plan_buckets_greedy_fill():
+    items = [("a", 10), ("b", 10), ("c", 50), ("d", 5)]
+    # 60-byte target at 4 B/elem: a+b reach 80 -> close; the oversized
+    # c gets its own bucket; d is the tail
+    buckets = plan_buckets(items, 60, 4)
+    assert [[n for n, _ in b] for b in buckets] == \
+        [["a", "b"], ["c"], ["d"]]
+    # 0 target = one monolithic bucket (the seed path)
+    assert len(plan_buckets(items, 0, 4)) == 1
+    assert plan_buckets([], 40, 4) == []
+
+
+def test_segment_bounds_cover_contiguously():
+    bounds = segment_bounds(480, 0.0005, 4)  # 131 elems per segment
+    assert bounds[0][0] == 0 and bounds[-1][1] == 480
+    for (lo, hi), (lo2, _) in zip(bounds, bounds[1:]):
+        assert hi == lo2 and hi > lo
+    assert segment_bounds(480, 0.0, 4) == [(0, 480)]
+    assert segment_bounds(0, 0.0005, 4) == []
+
+
+def test_overlap_fraction_is_all_but_last_bucket():
+    plan = build_plan([("a", 100), ("b", 100), ("c", 50)],
+                      0.0003, np.float32, "all_reduce")
+    # ~315-byte target -> 3 buckets of 400/400/200 bytes; the last has
+    # nothing to hide behind -> (1000 - 200) / 1000 overlappable
+    assert plan.num_buckets == 3
+    assert plan.overlap_fraction == pytest.approx(0.8)
+    mono = build_plan([("a", 100)], 0.0, np.float32, "all_reduce")
+    assert mono.overlap_fraction == 0.0
+
+
+# ---------------------------------------------------------------------------
+# knob surface
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_comm_knobs_normalization_and_errors():
+    assert resolve_comm_knobs(1.0, "f32") == (1.0, None)
+    assert resolve_comm_knobs(1.0, "float32") == (1.0, None)
+    mb, dt = resolve_comm_knobs(1.0, "bf16")
+    assert (mb, dt.name) == (1.0, "bfloat16")
+    with pytest.raises(MXNetError):
+        resolve_comm_knobs(-1.0, None)
+    with pytest.raises(MXNetError):
+        resolve_comm_knobs(0.0, "bf16")  # compression needs buckets
+
+
+def test_comm_dtype_without_buckets_rejected_at_ctor():
+    with pytest.raises(MXNetError):
+        _fused("sgd", {"learning_rate": 0.2}, False,
+               bucket_mb=0.0, grad_comm_dtype="bf16")
+
+
+def test_flat_optimizer_rejected_with_buckets():
+    # the flat update's concatenated grad buffer cannot keep the
+    # monolithic fusion shapes under per-bucket collectives
+    with pytest.raises(MXNetError):
+        _fused("sgd", {"learning_rate": 0.2}, False,
+               bucket_mb=0.001, flat_optimizer=True)
+
+
+def test_env_knob_enables_bucketing(monkeypatch):
+    monkeypatch.setenv("TP_GRAD_BUCKET_MB", "0.001")
+    step = _fused("sgd", {"learning_rate": 0.2}, False, bucket_mb=None)
+    assert step.bucket_plan().num_buckets >= 2
+
+
+def test_bucket_plan_report_and_telemetry(tmp_path):
+    telemetry.disable()
+    reg = telemetry.enable(str(tmp_path / "t.jsonl"))
+    try:
+        step = _fused("sgd", {"learning_rate": 0.2}, False,
+                      bucket_mb=0.001)
+        plan = step.bucket_plan()
+        rep = plan.report()
+        assert "bucket" in rep and "all_reduce" in rep
+        snap = reg.snapshot()["metrics"]
+        for metric in ("grad_comm_buckets_total", "grad_comm_bytes",
+                       "grad_comm_overlap_fraction"):
+            keys = [k for k in snap if metric in k and "fused" in k]
+            assert keys, (metric, sorted(snap))
+        bkeys = [k for k in snap
+                 if "grad_comm_buckets_total" in k and "fused" in k]
+        assert snap[bkeys[0]]["value"] == plan.num_buckets
+    finally:
+        telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# collectives byte accounting (satellite: actual-dtype wire bytes)
+# ---------------------------------------------------------------------------
+
+
+def _bytes_counted(reg, kind):
+    snap = reg.snapshot()["metrics"]
+    keys = [k for k in snap
+            if "collective_bytes_total" in k and kind in k]
+    return sum(snap[k]["value"] for k in keys)
+
+
+def test_all_reduce_counts_actual_dtype_bytes(tmp_path):
+    import jax
+    from jax.experimental.shard_map import shard_map
+
+    from incubator_mxnet_tpu.parallel import collectives
+
+    P = jax.sharding.PartitionSpec
+    mesh = parallel.build_mesh({"dp": 8})
+    telemetry.disable()
+    reg = telemetry.enable(str(tmp_path / "t.jsonl"))
+    try:
+        f = jax.jit(shard_map(
+            lambda x: collectives.all_reduce(x, "dp"), mesh=mesh,
+            in_specs=P("dp"), out_specs=P()))
+        import ml_dtypes
+
+        f(np.zeros((8, 4), ml_dtypes.bfloat16))
+        # per-device payload is (1, 4) bf16 = 8 wire bytes, not 16
+        assert _bytes_counted(reg, "all_reduce") == 8
+    finally:
+        telemetry.disable()
+
+
+def test_reduce_scatter_counts_per_shard_output_bytes(tmp_path):
+    import jax
+    from jax.experimental.shard_map import shard_map
+
+    from incubator_mxnet_tpu.parallel import collectives
+
+    P = jax.sharding.PartitionSpec
+    mesh = parallel.build_mesh({"dp": 8})
+    telemetry.disable()
+    reg = telemetry.enable(str(tmp_path / "t.jsonl"))
+    try:
+        f = jax.jit(shard_map(
+            lambda x: collectives.reduce_scatter(x, "dp"), mesh=mesh,
+            in_specs=P(None), out_specs=P("dp")))
+        # per-device input (64,) f32 = 256 bytes; each device RECEIVES
+        # 1/8 of that after the scatter -> 32 bytes on the wire
+        f(np.zeros((64,), np.float32))
+        assert _bytes_counted(reg, "reduce_scatter") == 32
+    finally:
+        telemetry.disable()
+
+
+def test_reduce_scatter_constraint_counts_shard_bytes(tmp_path):
+    import jax
+
+    from incubator_mxnet_tpu.parallel import collectives
+
+    P = jax.sharding.PartitionSpec
+    mesh = parallel.build_mesh({"dp": 8})
+    sh = jax.sharding.NamedSharding(mesh, P("dp"))
+    telemetry.disable()
+    reg = telemetry.enable(str(tmp_path / "t.jsonl"))
+    try:
+        f = jax.jit(
+            lambda x: collectives.reduce_scatter_constraint(x, sh))
+        f(np.zeros((16, 4), np.float32))
+        # (16, 4) f32 constrained to P('dp'): one (2, 4) shard lands
+        # on each device -> 32 bytes counted, not the full 256
+        assert _bytes_counted(reg, "reduce_scatter") == 32
+    finally:
+        telemetry.disable()

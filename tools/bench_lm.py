@@ -15,6 +15,8 @@ TP_LM_OPT_DTYPE / TP_LM_GRAD_DTYPE (bf16 opt-ins, PERF.md §21b),
 TP_LM_MATMUL_DTYPE (fp8 delayed-scaling matmuls, docs/quantization.md),
 TP_LM_MOE (experts per layer, 0 = dense) / TP_LM_MOE_TOPK (2) /
 TP_LM_MOE_CAP (1.25) — the MoE model family (PERF.md §8e),
+TP_LM_GRAD_BUCKET_MB / TP_LM_GRAD_COMM_DTYPE (bucketed gradient
+collectives + bf16 wire, docs/comm_overlap.md),
 TP_LM_DP (1: data-parallel mesh size) and TP_LM_SHARD_OPT=1
 (ZeRO-1 optimizer-state sharding over that dp axis, docs/zero.md),
 TP_LM_SMALL=1 (CPU smoke), TP_SUSTAINED_TFLOPS (154, PERF.md §10),
@@ -110,6 +112,8 @@ def run(defaults=None):
     moe_cap = float(cfg("TP_LM_MOE_CAP", 1.25))
     ndp = int(cfg("TP_LM_DP", 1))
     shard_opt = cfg("TP_LM_SHARD_OPT", "0") == "1"
+    bucket_mb = float(cfg("TP_LM_GRAD_BUCKET_MB", 0))
+    comm_dtype = cfg("TP_LM_GRAD_COMM_DTYPE", "") or None
     net = mx.models.transformer_lm(
         vocab_size=V, embed=E, heads=heads,
         num_layers=L, seq_len=S, batch_size=B, dtype=dtype, head=head,
@@ -123,8 +127,10 @@ def run(defaults=None):
         grad_dtype=cfg("TP_LM_GRAD_DTYPE", "") or None,
         matmul_dtype=cfg("TP_LM_MATMUL_DTYPE", "") or None,
         initializer=mx.initializer.Xavier(),
-        shard_optimizer=shard_opt)
+        shard_optimizer=shard_opt,
+        grad_bucket_mb=bucket_mb, grad_comm_dtype=comm_dtype)
     _, opt_bytes_dev = step.optimizer_state_bytes()
+    plan = step.bucket_plan()
 
     rng = np.random.RandomState(0)
     bd = {"data": jax.device_put(
@@ -170,6 +176,13 @@ def run(defaults=None):
         "matmul_dtype": cfg("TP_LM_MATMUL_DTYPE", "") or "float32",
         "mesh_dp": ndp, "shard_optimizer": shard_opt,
         "opt_state_bytes_per_device": int(opt_bytes_dev),
+        # bucketed grad-collective plan (docs/comm_overlap.md): what
+        # the step ACTUALLY issues — monolithic runs report 1 bucket
+        "grad_bucket_mb": bucket_mb,
+        "grad_comm_dtype": plan.wire_dtype.name,
+        "grad_comm_buckets": plan.num_buckets,
+        "grad_comm_bytes": int(plan.total_bytes),
+        "grad_comm_overlap_fraction": round(plan.overlap_fraction, 3),
         "model_tflops_per_sec": round(tflops, 1),
         "mfu_vs_sustained": round(tflops / sustained, 3),
         "mfu_vs_peak": round(tflops / peak, 3)}

@@ -43,7 +43,11 @@ Checks, in order:
    replica-kill failover losing nothing, and drain-then-detach
    completing all in-flight work (``tests/test_router.py``;
    ``TP_CHECK_ROUTER=0`` skips);
-12. **static-analysis** — the ``tools/lint.py`` suite (graph verifier
+12. **comm** — the comm-overlap gate: f32-wire bucketed gradient
+   collectives bit-identical to the monolithic path on the fused AND
+   pipeline steps, ZeRO on/off, grad-accum >= 1
+   (``tests/test_grad_buckets.py``; ``TP_CHECK_COMM=0`` skips);
+13. **static-analysis** — the ``tools/lint.py`` suite (graph verifier
    over the model zoo, tracing-hazard lint, lock-order checker,
    lockset race detector, env-knob drift incl. documented defaults;
    docs/static_analysis.md): zero unsuppressed findings (needs jax —
@@ -419,6 +423,37 @@ def check_resilience(problems):
                         + "\n  ".join(tail))
 
 
+def check_comm(problems):
+    """Comm-overlap gate (docs/comm_overlap.md): the bucketed gradient
+    collective scheduler at f32 wire dtype must leave parameters
+    bit-identical to the monolithic seed path — fused step (ZeRO
+    on/off, grad-accum 1 and 2, sgd-mom + adam) and pipeline step —
+    plus the bf16-wire composition envelope (needs jax — skip with
+    ``TP_CHECK_COMM=0``)."""
+    if os.environ.get("TP_CHECK_COMM", "1") == "0":
+        return
+    import subprocess
+
+    tests = "tests/test_grad_buckets.py"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q",
+             "-p", "no:cacheprovider", "-p", "no:randomly",
+             tests + "::test_fused_bucketed_bit_identical",
+             tests + "::test_pipeline_bucketed_bit_identical",
+             tests + "::test_bf16_wire_zero_accum_envelope"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        problems.append("comm: bit-equality run did not finish: %s" % e)
+        return
+    if proc.returncode != 0:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-12:]
+        problems.append("comm: comm-overlap gate failed:\n  "
+                        + "\n  ".join(tail))
+
+
 def check_static_analysis(problems):
     """Static-analysis gate (docs/static_analysis.md): run the full
     ``tools/lint.py`` suite — graph verifier over the model zoo,
@@ -459,6 +494,7 @@ def main():
     check_overlap(problems)
     check_quant(problems)
     check_resilience(problems)
+    check_comm(problems)
     check_static_analysis(problems)
     for p in problems:
         print(p)
