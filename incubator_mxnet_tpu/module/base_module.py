@@ -13,7 +13,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import metric as metric_mod
-from .. import telemetry
+from .. import telemetry, tracing
 from ..base import MXNetError
 from ..initializer import Uniform
 from ..model import BatchEndParam
@@ -205,6 +205,11 @@ class BaseModule:
         # sampled once per fit: telemetry can't toggle mid-training, and the
         # disabled loop must not pay even the enabled() call per step
         _tele = telemetry.enabled()
+        # same contract for the flight recorder: one per-step trace
+        # (dispatch / input-wait here; fence, PS RPC, and checkpoint
+        # spans land on it via tracing.train_context())
+        _trace_on = tracing.enabled()
+        _tctx = None
         if _tele:
             _step_fence = get_env("TELEMETRY_STEP_FENCE", False, bool)
             _step_hist = telemetry.histogram("step_latency_seconds")
@@ -244,8 +249,19 @@ class BaseModule:
                     monitor.tic()
                 if _tele:
                     _t0 = time.monotonic()
+                if _trace_on:
+                    _tctx = tracing.start_trace(
+                        "train.step", {"step": _global_step + 1,
+                                       "epoch": epoch})
+                    tracing.set_train_context(_tctx)
+                    _tr0 = time.monotonic()
                 self.forward_backward(data_batch)
                 self.update()
+                if _trace_on:
+                    # host dispatch of the step program (device time
+                    # surfaces later, at the ring fence)
+                    tracing.record(_tctx, "train.dispatch", _tr0,
+                                   time.monotonic())
                 _outs = None
                 if _ring is not None or _dev_metric is not None:
                     # the cached step outputs: raw jax arrays when the
@@ -286,11 +302,16 @@ class BaseModule:
                     if _bs:
                         _samples_ctr.inc(_bs)
                         _sps_gauge.set(_bs / max(_dt, 1e-9))
+                if _trace_on:
+                    _tr0 = time.monotonic()
                 try:
                     next_data_batch = next(data_iter)
                     self.prepare(next_data_batch)
                 except StopIteration:
                     end_of_batch = True
+                if _trace_on:
+                    tracing.record(_tctx, "train.input_wait", _tr0,
+                                   time.monotonic())
                 if _ring is not None:
                     # admit this step into the in-flight window; fences
                     # the step TP_MAX_INFLIGHT behind (PERF.md true fence)
@@ -324,10 +345,19 @@ class BaseModule:
                             _dev_metric.drain()
                         if _ring is not None:
                             _ring.drain()
+                        if _trace_on:
+                            tracing.set_train_context(None)
+                            tracing.end_trace(_tctx)
+                            tracing.flush()
                         self.logger.info(
                             "Preemption checkpoint committed at step %d "
                             "— exiting fit cleanly", _global_step)
                         return
+                if _trace_on:
+                    # step boundary: close this step's trace (tail
+                    # sampling decides whether it is kept)
+                    tracing.set_train_context(None)
+                    tracing.end_trace(_tctx)
 
             if _dev_metric is not None:
                 _dev_metric.drain()  # fold the tail window before logging
@@ -340,6 +370,8 @@ class BaseModule:
             if _tele:
                 _epochs_ctr.inc()
                 telemetry.flush()
+            if _trace_on:
+                tracing.flush()  # epoch boundary: persist kept traces
 
             arg_p, aux_p = self.get_params()
             self.set_params(arg_p, aux_p)

@@ -20,12 +20,13 @@ restores the fully synchronous legacy loop.
 """
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Any, Optional
 
 import numpy as np
 
-from . import telemetry
+from . import telemetry, tracing
 from .base import get_env
 
 __all__ = ["max_inflight", "fence_handle", "InflightRing", "drain_target"]
@@ -80,7 +81,15 @@ class InflightRing:
     def _wait(handle) -> None:
         # host-read one scalar: the only fence that provably waits for
         # device execution on every platform (PERF.md §1)
-        np.asarray(handle).ravel()[:1]
+        tctx = tracing.train_context()
+        if tctx is None:
+            np.asarray(handle).ravel()[:1]
+        else:
+            t0 = time.monotonic()
+            np.asarray(handle).ravel()[:1]
+            # the fence is where overlapped device time surfaces on
+            # the host — the span the step trace attributes waits to
+            tracing.record(tctx, "train.fence", t0, time.monotonic())
         telemetry.counter("inflight_fences_total").inc()
 
     def push(self, handle: Optional[Any]) -> None:

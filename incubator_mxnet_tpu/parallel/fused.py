@@ -20,12 +20,13 @@ the per-device state footprint drops to ~1/dp of the replicated layout.
 """
 from __future__ import annotations
 
+import time
 import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..base import MXNetError, dtype_np, get_env
 from ..ops.registry import OpContext, get_op
 from .mesh import (data_parallel_spec, default_mesh, replicated_spec)
@@ -804,6 +805,8 @@ class FusedTrainStep:
         import jax.numpy as jnp
 
         telemetry.counter("fused_steps_total").inc()
+        _tctx = tracing.train_context()
+        _tr0 = time.monotonic() if _tctx is not None else 0.0
         self.num_update += 1
         lr = self.lr_scheduler(self.num_update) if self.lr_scheduler \
             else self.lr
@@ -831,6 +834,18 @@ class FusedTrainStep:
                 self.params, self.opt_states, self.aux, self.quant_state,
                 self._key, jnp.float32(lr),
                 jnp.float32(self.num_update), vals)
+        if _tctx is not None and self._bucket_mb > 0:
+            # the bucketed collectives live INSIDE the jitted step, so
+            # the host-observable span is the dispatch of the program
+            # that carries them, annotated with the static plan — the
+            # overlap fraction is the compile-time bound, the fence
+            # span shows where the wire time actually surfaces
+            tracing.record(
+                _tctx, "train.collective", _tr0, time.monotonic(),
+                {"buckets": self._bucket_plan.num_buckets,
+                 "bytes": self._bucket_plan.total_bytes,
+                 "overlap_fraction":
+                     round(self._bucket_plan.overlap_fraction, 4)})
         if self._ring is not None and outs:
             from ..overlap import fence_handle
 

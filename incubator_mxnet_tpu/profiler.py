@@ -34,7 +34,7 @@ from typing import List, Optional
 from .base import get_env
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "pause", "resume", "Scope", "record_counter",
+           "pause", "resume", "Scope", "record_counter", "record_async",
            "start_xla_trace", "stop_xla_trace"]
 
 _lock = threading.Lock()
@@ -63,6 +63,11 @@ class _Profiler:
         # on ``running``: the metrics layer decides when to publish, the
         # trace is just one of its exposition formats
         self.counters: List[tuple] = []
+        # (name, id, t0, t1, cat, args) async spans from the tracing
+        # layer — like counters, NOT gated on ``running``: the flight
+        # recorder owns its own sampling, the trace file is just one of
+        # its exposition formats
+        self.asyncs: List[tuple] = []
         self._hook_installed = False
         self._epoch = time.perf_counter()
 
@@ -101,6 +106,14 @@ class _Profiler:
         with _lock:
             self.counters.append((name, float(value), t))
 
+    def record_async(self, name: str, aid: str, t0: float, t1: float,
+                     cat: str = "trace", args=None) -> None:
+        """Append one async span — dumped as a Chrome ``"b"``/``"e"``
+        pair keyed by ``aid`` so all spans of one distributed trace
+        render as a single async track."""
+        with _lock:
+            self.asyncs.append((name, aid, t0, t1, cat, args))
+
     def dump(self, fname: Optional[str] = None) -> str:
         """Write accumulated events as Chrome trace-event JSON
         (``Profiler::DumpProfile`` / ``EmitEvent``, profiler.h:75-148)."""
@@ -108,6 +121,7 @@ class _Profiler:
         with _lock:
             events = list(self.events)
             counters = list(self.counters)
+            asyncs = list(self.asyncs)
         traces = []
         # process-name metadata, like EmitPid
         tids = sorted({e.tid for e in events})
@@ -131,6 +145,19 @@ class _Profiler:
                 "ts": self.now_us(t), "pid": 0, "tid": 0,
                 "args": {"value": value},
             })
+        for name, aid, t0, t1, cat, args in asyncs:
+            traces.append({
+                "name": name, "cat": cat, "ph": "b", "id": aid,
+                "ts": self.now_us(t0), "pid": 0, "tid": 0,
+                "args": args or {},
+            })
+            traces.append({
+                # args repeated on the close half: consumers pair b/e
+                # by (id, name, args.span_id)
+                "name": name, "cat": cat, "ph": "e", "id": aid,
+                "ts": self.now_us(t1), "pid": 0, "tid": 0,
+                "args": args or {},
+            })
         with open(fname, "w") as f:
             json.dump({"traceEvents": traces, "displayTimeUnit": "ms"}, f)
         return fname
@@ -152,6 +179,7 @@ def profiler_set_state(state: str = "stop") -> None:
         with _lock:
             _prof.events = []  # fresh capture per run/stop session
             _prof.counters = []
+            _prof.asyncs = []
         _prof.install_hook()
         _prof.running = True
     elif state in ("stop", 0):
@@ -178,6 +206,13 @@ def record_counter(name: str, value: float,
                    t: Optional[float] = None) -> None:
     """Telemetry-facing entry: add one counter sample to the trace."""
     _prof.record_counter(name, value, t)
+
+
+def record_async(name: str, aid: str, t0: float, t1: float,
+                 cat: str = "trace", args=None) -> None:
+    """Tracing-facing entry: add one async ``"b"``/``"e"`` span pair
+    keyed by ``aid`` (perf_counter-epoch seconds)."""
+    _prof.record_async(name, aid, t0, t1, cat, args)
 
 
 class Scope:

@@ -34,7 +34,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from . import telemetry
+from . import telemetry, tracing
 from .base import MXNetError, get_env
 from .resilience import faults as _faults
 
@@ -756,7 +756,17 @@ class PSClient:
         for attempt in range(attempts):
             try:
                 _faults.inject(verb)
-                reply = self._pool.rpc(self.servers[sidx], msg)
+                tctx = tracing.train_context()
+                if tctx is None:
+                    reply = self._pool.rpc(self.servers[sidx], msg)
+                else:
+                    # attribute the PS round-trip to the current train
+                    # step's trace (docs/tracing.md)
+                    tr0 = time.monotonic()
+                    reply = self._pool.rpc(self.servers[sidx], msg)
+                    tracing.record(tctx, "train.rpc", tr0,
+                                   time.monotonic(),
+                                   _verb_labels(verb))
                 if tele:
                     telemetry.histogram("ps_rpc_seconds", lab).observe(
                         time.monotonic() - t0)

@@ -61,7 +61,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..analysis.race_checker import race_audit
 from ..base import MXNetError, get_env
 from ..overlap import drain_target
@@ -113,6 +113,21 @@ def _on_signal(signum, frame):
     logging.warning("resilience: signal %d received — final checkpoint "
                     "at the next step boundary, then clean exit", signum)
     request_preemption()
+    _flush_observability()
+
+
+def _flush_observability() -> None:
+    """Best-effort flush of the metrics/trace tail — the exit-time
+    dumps never run when a SIGTERM'd process is killed before atexit,
+    so preemption flushes eagerly (docs/tracing.md)."""
+    try:
+        telemetry.flush()
+    except Exception:  # noqa: BLE001 — flush must never mask shutdown
+        pass
+    try:
+        tracing.flush()
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def install_preemption_handler(signals: Optional[Tuple[int, ...]] = None
@@ -358,6 +373,9 @@ class CheckpointManager:
             self._queue.join()
             self._queue.put(None)
             self._thread.join(timeout=60)
+        # a closing manager is a run winding down: persist the
+        # observability tail now, not at interpreter exit
+        _flush_observability()
 
     def __enter__(self):
         return self
@@ -486,6 +504,15 @@ class CheckpointManager:
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(final, _COMMIT))
         dt = time.monotonic() - t0
+        tctx = tracing.train_context()
+        if tctx is not None:
+            # async writes land on whichever step is CURRENT when the
+            # write commits — honest overlap attribution: the span
+            # shows checkpoint I/O concurrent with that step's compute
+            tracing.record(tctx, "train.checkpoint", t0, t0 + dt,
+                           {"step": int(step),
+                            "mode": "async" if self._queue is not None
+                            else "sync"})
         with self._mirror_lock:
             self.saves_completed += 1
             self.last_save_seconds = dt

@@ -44,7 +44,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..analysis.race_checker import race_audit
 from ..base import MXNetError, get_env
 from .engine import ServeStats, bucket_batch, bucket_length
@@ -619,7 +619,7 @@ class GenerationResult:
 class _GenPending:
     __slots__ = ("tokens", "max_new", "temperature", "top_k",
                  "stop_token", "return_logits", "deadline", "t_submit",
-                 "future", "slot", "shared_tokens")
+                 "future", "slot", "shared_tokens", "trace")
 
     def __init__(self, tokens, max_new, temperature, top_k, stop_token,
                  return_logits, deadline, future):
@@ -636,13 +636,14 @@ class _GenPending:
         # shared-prefix token count)
         self.slot = None
         self.shared_tokens = 0
+        self.trace = None        # tracing.SpanContext (from the wire)
 
 
 class _Seq:
     """One running sequence occupying a cache slot."""
 
     __slots__ = ("req", "slot", "length", "last_token", "generated",
-                 "logits", "t_first", "t_last")
+                 "logits", "t_first", "t_last", "t_cursor")
 
     def __init__(self, req, slot, prompt_len):
         self.req = req
@@ -653,6 +654,11 @@ class _Seq:
         self.logits: List[np.ndarray] = []
         self.t_first = None
         self.t_last = None
+        # phase cursor for tracing: each recorded phase span starts
+        # where the previous one ended, so a trace's queue + prefill +
+        # decode-tick durations sum to the engine-observed latency by
+        # construction (docs/tracing.md)
+        self.t_cursor = req.t_submit
 
     @property
     def done(self):
@@ -748,7 +754,8 @@ class GenerationEngine:
                temperature: float = 0.0, top_k: int = 0,
                stop_token: Optional[int] = None,
                return_logits: bool = False,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               trace_ctx=None) -> Future:
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size < 1:
             raise MXNetError("empty prompt")
@@ -759,6 +766,10 @@ class GenerationEngine:
         req = _GenPending(tokens, int(max_new_tokens), temperature,
                           int(top_k), stop_token, return_logits,
                           deadline, fut)
+        if trace_ctx is not None:
+            # join (in-process fleet) or adopt (remote replica) the
+            # propagated trace; None when tracing is disabled here
+            req.trace = tracing.from_wire(trace_ctx)
         with self._cond:
             if self._closed:
                 raise MXNetError("engine %r is closed" % self.name)
@@ -860,6 +871,10 @@ class GenerationEngine:
                 with self.stats.lock:
                     self.stats.expired += 1
                 telemetry.counter("serve_deadline_expired_total").inc()
+                if p.trace is not None:
+                    tracing.flag(p.trace, "deadline")
+                    tracing.record(p.trace, "serve.queue",
+                                   p.t_submit, now)
                 p.future.set_exception(MXNetError(
                     "request deadline expired after %.1f ms in queue"
                     % ((now - p.t_submit) * 1e3)))
@@ -957,6 +972,7 @@ class GenerationEngine:
                 with self._cond:
                     self.prefill_tokens += npref
                 telemetry.counter("serve_prefill_tokens_total").inc(npref)
+                t_p0 = time.monotonic()
                 self._cache_k, self._cache_v, logits = \
                     self.model.prefill(self._cache_k, self._cache_v,
                                        toks, lens, slots)
@@ -967,6 +983,14 @@ class GenerationEngine:
                     # tp-lint: disable=race-unlocked-shared-state -- loop-owned; advisory scan
                     self._seqs[free[j]] = seq
                     self._lengths[free[j]] = r.tokens.size
+                    if r.trace is not None:
+                        tracing.record(r.trace, "serve.queue",
+                                       r.t_submit, t_p0)
+                        tracing.record(r.trace, "serve.prefill",
+                                       t_p0, now,
+                                       {"tokens": int(r.tokens.size),
+                                        "bucket": int(L)})
+                        seq.t_cursor = now
                     self._emit(seq, logits[j], now)
                 free = free[n:]
 
@@ -1054,6 +1078,15 @@ class GenerationEngine:
             # the decode wrote this token's K/V at position `length`
             seq.length += 1
             self._lengths[seq.slot] = seq.length
+            if seq.req.trace is not None:
+                # tick span runs from the previous phase boundary, so
+                # batch-wait between ticks is attributed to the tick.
+                # Recorded BEFORE _emit: a finishing sequence settles
+                # (and finalizes its trace) inside _emit, which would
+                # drop the final tick's span
+                tracing.record(seq.req.trace, "serve.decode_tick",
+                               seq.t_cursor, now)
+                seq.t_cursor = now
             self._emit(seq, logits[seq.slot], now)
             # deadline: a running sequence past its deadline stops with
             # what it has rather than holding the slot

@@ -50,7 +50,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..base import MXNetError, get_env
 from .engine import bucket_batch, bucket_length
 from .generate import GenerationEngine, KVTransformerLM, _GenPending, \
@@ -833,11 +833,19 @@ class PagedGenerationEngine(GenerationEngine):
             if rest or not free:
                 rest.append(p)
                 continue
+            t_a0 = time.monotonic() if p.trace is not None else 0.0
             shared = self._kv.try_admit(free[0], p.tokens, p.max_new,
                                         extra=self._spec_reserve_extra())
             if shared is None:
                 rest.append(p)
                 continue
+            if p.trace is not None:
+                # reservation cost: overlaps the queue phase, so
+                # trace_query treats it as attribution detail, not a
+                # critical-path phase
+                tracing.record(p.trace, "serve.page_alloc", t_a0,
+                               time.monotonic(),
+                               {"shared_tokens": int(shared)})
             p.slot = free.pop(0)
             p.shared_tokens = shared
             take.append(p)
@@ -858,6 +866,10 @@ class PagedGenerationEngine(GenerationEngine):
                 self._abort_admission(r)
                 self.stats.expired += 1
                 telemetry.counter("serve_deadline_expired_total").inc()
+                if r.trace is not None:
+                    tracing.flag(r.trace, "deadline")
+                    tracing.record(r.trace, "serve.queue",
+                                   r.t_submit, now)
                 r.future.set_exception(MXNetError(
                     "request deadline expired after %.1f ms in queue"
                     % ((now - r.t_submit) * 1e3)))
@@ -887,6 +899,7 @@ class PagedGenerationEngine(GenerationEngine):
                 telemetry.counter("serve_prefill_tokens_total").inc(
                     int(sum(r.tokens.size - r.shared_tokens
                             for r in chunk)))
+                t_p0 = time.monotonic()
                 logits = np.asarray(
                     self._kv.prefill(toks, plens, slens, slots))
                 now = time.monotonic()
@@ -894,6 +907,16 @@ class PagedGenerationEngine(GenerationEngine):
                     seq = _Seq(r, r.slot, r.tokens.size)
                     self._seqs[r.slot] = seq
                     self._lengths[r.slot] = r.tokens.size
+                    if r.trace is not None:
+                        tracing.record(r.trace, "serve.queue",
+                                       r.t_submit, t_p0)
+                        tracing.record(
+                            r.trace, "serve.prefill", t_p0, now,
+                            {"tokens": int(r.tokens.size
+                                           - r.shared_tokens),
+                             "shared_tokens": int(r.shared_tokens),
+                             "bucket": int(L)})
+                        seq.t_cursor = now
                     # register before _emit: a 1-token request finishes
                     # inside _emit and releases the slot immediately —
                     # its prompt pages must already be content-

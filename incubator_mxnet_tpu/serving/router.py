@@ -53,9 +53,17 @@ module).
 
 Telemetry: ``fleet_requests_total{tenant,class}``,
 ``fleet_routed_prefix_hits_total``, ``fleet_prefix_hit_tokens_total``,
-``fleet_shed_total{reason}``, ``fleet_replica_dead_total``,
+``fleet_shed_total{reason,class}``, ``fleet_replica_dead_total``,
 ``fleet_retries_total``, ``fleet_drain_seconds``,
+``fleet_request_seconds{class}``, ``fleet_slo_attainment{class}``,
 ``fleet_replicas_alive``.  See docs/fleet_serving.md.
+
+Tracing (docs/tracing.md): when ``TP_TRACING`` is on, ``submit``
+opens the root ``serve.request`` span at admission, records the
+``router.admit``/``router.shed`` phases, ships the context to the
+replica inside the submit ``kw`` (and the ps.py framing for TCP
+replicas), and closes the trace at settle — flagging shed, errored,
+and deadline-busting requests so tail sampling always keeps them.
 """
 from __future__ import annotations
 
@@ -68,7 +76,7 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from .. import ps as _ps
-from .. import telemetry
+from .. import telemetry, tracing
 from ..analysis.race_checker import race_audit
 from ..base import MXNetError, get_env
 from .generate import GenerationResult
@@ -168,18 +176,24 @@ class ReplicaServer(_ps._Node):
         except OSError:
             pass  # peer gone; its reader fails the waiters
 
-    def _reply_result(self, handler, wlock, rid, fut) -> None:
+    def _reply_result(self, handler, wlock, rid, fut,
+                      trace_wire=None) -> None:
         exc = fut.exception()
         if exc is not None:
             self._reply(handler, wlock, {"status": "error", "rid": rid,
                                          "error": repr(exc)})
-            return
-        r = fut.result()
-        self._reply(handler, wlock, {
-            "status": "ok", "rid": rid,
-            "tokens": np.asarray(r.tokens, np.int32),
-            "logits": r.logits, "prompt_len": int(r.prompt_len),
-            "ttft_s": float(r.ttft_s)})
+        else:
+            r = fut.result()
+            self._reply(handler, wlock, {
+                "status": "ok", "rid": rid,
+                "tokens": np.asarray(r.tokens, np.int32),
+                "logits": r.logits, "prompt_len": int(r.prompt_len),
+                "ttft_s": float(r.ttft_s)})
+        if trace_wire is not None:
+            # finalize the trace fragment this process adopted from
+            # the wire (no-op when the trace is locally rooted — the
+            # in-process fleet shares one recorder)
+            tracing.finish_remote(trace_wire)
 
     def _handle(self, msg, handler):
         wlock = self._send_lock(handler)
@@ -192,12 +206,14 @@ class ReplicaServer(_ps._Node):
                     "status": "ok", "rid": rid,
                     "report": self.engine.load_report()})
             elif cmd == "submit":
+                kw = msg.get("kw") or {}
+                tw = kw.get("trace_ctx") if tracing.enabled() else None
                 fut = self.engine.submit(
                     np.asarray(msg["tokens"], np.int32),
-                    int(msg["max_new"]), **(msg.get("kw") or {}))
+                    int(msg["max_new"]), **kw)
                 fut.add_done_callback(
-                    lambda f, r=rid, h=handler, w=wlock:
-                    self._reply_result(h, w, r, f))
+                    lambda f, r=rid, h=handler, w=wlock, t=tw:
+                    self._reply_result(h, w, r, f, t))
             else:
                 self._reply(handler, wlock, {
                     "status": "error", "rid": rid,
@@ -311,10 +327,23 @@ class TcpReplica(Replica):
     # ------------------------------------------------------------- api
     def submit(self, tokens, max_new_tokens: int = 16, **kw) -> Future:
         toks = np.asarray(tokens, np.int32).reshape(-1)
+        tctx = tracing.from_wire(kw.get("trace_ctx")) \
+            if "trace_ctx" in kw else None
+        t_rpc = time.monotonic() if tctx is not None else 0.0
         raw = self._call({"cmd": "submit", "tokens": toks,
                           "max_new": int(max_new_tokens), "kw": kw})
         out: Future = Future()
-        raw.add_done_callback(lambda f: _relay_result(f, out))
+        if tctx is not None:
+            def _done(f, c=tctx, t0=t_rpc):
+                # wire round-trip attribution: overlaps the replica's
+                # queue/prefill/decode spans, so trace_query reports it
+                # as an overlay, not a critical-path phase
+                tracing.record(c, "serve.rpc", t0, time.monotonic(),
+                               {"replica": self.name})
+                _relay_result(f, out)
+            raw.add_done_callback(_done)
+        else:
+            raw.add_done_callback(lambda f: _relay_result(f, out))
         return out
 
     def load_report(self) -> Dict[str, object]:
@@ -374,7 +403,7 @@ class _Placement:
     __slots__ = ("rid", "tokens", "max_new", "kw", "tenant", "klass",
                  "session", "retryable", "deadline", "chains", "tried",
                  "retries_left", "epoch", "state", "done", "last_exc",
-                 "future", "t_submit")
+                 "future", "t_submit", "trace")
 
     def __init__(self, rid, tokens, max_new, kw, tenant, klass,
                  session, retryable, deadline, chains, retries):
@@ -396,6 +425,7 @@ class _Placement:
         self.last_exc: Optional[BaseException] = None
         self.future: Future = Future()
         self.t_submit = time.monotonic()
+        self.trace = None                 # tracing.SpanContext or None
 
 
 class _ReplicaState:
@@ -492,6 +522,11 @@ class ServingRouter:
         self._retries_n = 0
         self._deaths = 0
         self._shed: Dict[str, int] = {}
+        self._shed_by_class: Dict[str, int] = {}
+        # per-deadline-class SLO attainment (settled requests only;
+        # sheds are visible separately in shed_by_class)
+        self._class_done: Dict[str, int] = {}
+        self._class_met: Dict[str, int] = {}
         for r in replicas:
             self.attach(r)
         self._stop = threading.Event()
@@ -568,6 +603,14 @@ class ServingRouter:
         now = time.monotonic()
         deadline = now + deadline_ms / 1e3 \
             if deadline_ms is not None else None
+        # root span opened at admission so even shed requests leave a
+        # (flagged, always-kept) trace; the attrs dict only allocates
+        # on the enabled path
+        trace = tracing.start_trace(
+            "serve.request", {"tenant": tenant, "class": klass,
+                              "prompt_tokens": int(toks.size),
+                              "max_new": int(max_new_tokens)}) \
+            if tracing.enabled() else None
         # digest chains per page size seen in the fleet, computed
         # OUTSIDE the lock (hashing is the expensive part of routing)
         with self._lock:
@@ -583,6 +626,7 @@ class ServingRouter:
                              int(max_new_tokens), kw, tenant, klass,
                              session, retryable, deadline, chains,
                              self._retries)
+            rec.trace = trace
             quota = self._buckets.get(tenant)
             if quota is not None and not quota.try_take(
                     toks.size + rec.max_new, now):
@@ -609,8 +653,17 @@ class ServingRouter:
                      detail: str) -> None:
         """Count and raise an admission rejection (lock held)."""
         self._shed[reason] = self._shed.get(reason, 0) + 1
+        self._shed_by_class[rec.klass] = \
+            self._shed_by_class.get(rec.klass, 0) + 1
         telemetry.counter("fleet_shed_total",
-                          {"reason": reason}).inc()
+                          {"reason": reason,
+                           "class": rec.klass}).inc()
+        if rec.trace is not None:
+            # tail-sampling contract: shed traces are always kept
+            tracing.flag(rec.trace, "shed")
+            tracing.record(rec.trace, "router.shed", rec.t_submit,
+                           time.monotonic(), {"reason": reason})
+            tracing.end_trace(rec.trace)
         raise MXNetError(
             "fleet shed [%s] tenant=%r class=%r: %s"
             % (reason, rec.tenant, rec.klass, detail))
@@ -785,6 +838,15 @@ class ServingRouter:
             kw["deadline_ms"] = remaining
         with self._lock:
             epoch = rec.epoch
+        if rec.trace is not None:
+            # context rides the existing kw dict through the replica
+            # protocol (and the ps.py framing, for TCP replicas)
+            kw["trace_ctx"] = rec.trace.to_wire()
+            if epoch == 1:
+                # admission span: validation + quota + placement cost
+                tracing.record(rec.trace, "router.admit",
+                               rec.t_submit, now,
+                               {"replica": st.name})
         try:
             efut = st.replica.submit(rec.tokens, rec.max_new, **kw)
         except Exception as exc:  # noqa: BLE001 — re-picked/settled
@@ -850,6 +912,9 @@ class ServingRouter:
     def _settle(self, rec: _Placement, result=None, exc=None) -> None:
         """Resolve the router future exactly once and release the
         in-flight record (drain waiters are notified)."""
+        now = time.monotonic()
+        met = exc is None and (rec.deadline is None
+                               or now <= rec.deadline)
         with self._lock:
             if rec.done:
                 return
@@ -858,7 +923,26 @@ class ServingRouter:
             if st is not None:
                 st.inflight.pop(rec.rid, None)
                 rec.state = None
+            self._class_done[rec.klass] = \
+                self._class_done.get(rec.klass, 0) + 1
+            if met:
+                self._class_met[rec.klass] = \
+                    self._class_met.get(rec.klass, 0) + 1
+            done_n = self._class_done[rec.klass]
+            met_n = self._class_met.get(rec.klass, 0)
             self._lock.notify_all()
+        lab = {"class": rec.klass}
+        telemetry.histogram("fleet_request_seconds", lab).observe(
+            now - rec.t_submit)
+        telemetry.gauge("fleet_slo_attainment", lab).set(
+            met_n / done_n)
+        if rec.trace is not None:
+            # tail flags: errored / deadline-busting traces always kept
+            if exc is not None:
+                tracing.flag(rec.trace, "error")
+            if rec.deadline is not None and now > rec.deadline:
+                tracing.flag(rec.trace, "deadline")
+            tracing.end_trace(rec.trace)
         if exc is None:
             rec.future.set_result(result)
         else:
@@ -1031,6 +1115,10 @@ class ServingRouter:
                 "retries": self._retries_n,
                 "deaths": self._deaths,
                 "shed": dict(self._shed),
+                "shed_by_class": dict(self._shed_by_class),
+                "slo_attainment": {
+                    k: self._class_met.get(k, 0) / n
+                    for k, n in self._class_done.items() if n},
             }
 
     def close(self, close_replicas: bool = False) -> None:

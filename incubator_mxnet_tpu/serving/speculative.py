@@ -54,7 +54,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..analysis.race_checker import race_audit
 from ..base import MXNetError, get_env
 from .engine import bucket_batch, bucket_length
@@ -275,6 +275,9 @@ class _SpecMixin:
             with self.stats.lock:
                 self.stats.expired += 1
             telemetry.counter("serve_deadline_expired_total").inc()
+            if r.trace is not None:
+                tracing.flag(r.trace, "deadline")
+                tracing.record(r.trace, "serve.queue", r.t_submit, now)
             r.future.set_exception(MXNetError(
                 "request deadline expired after %.1f ms in queue"
                 % ((now - r.t_submit) * 1e3)))
@@ -289,6 +292,11 @@ class _SpecMixin:
         self._seqs[slot] = seq
         self._lengths[slot] = r.shared_tokens
         self._chunking[slot] = _ChunkState(r, seq)
+        if r.trace is not None:
+            # queue phase ends at seating; every later prefill chunk
+            # extends the cursor from here
+            tracing.record(r.trace, "serve.queue", r.t_submit, now)
+            seq.t_cursor = now
         # the draft ingests the WHOLE prompt up front: chunking exists
         # to bound the TARGET's per-tick prefill compute, and the
         # draft is small by construction
@@ -337,6 +345,10 @@ class _SpecMixin:
                 with self.stats.lock:
                     self.stats.expired += 1
                 telemetry.counter("serve_deadline_expired_total").inc()
+                if st.req.trace is not None:
+                    tracing.flag(st.req.trace, "deadline")
+                    tracing.record(st.req.trace, "serve.prefill",
+                                   st.seq.t_cursor, now)
                 st.req.future.set_exception(MXNetError(
                     "request deadline expired after %.1f ms mid-"
                     "prefill" % ((now - st.req.t_submit) * 1e3)))
@@ -374,6 +386,13 @@ class _SpecMixin:
             st.seq.length += int(takes[j])
             self._lengths[slot] = st.seq.length
             self._register_chunk(st)
+            if st.req.trace is not None:
+                # one prefill span per chunk, cursor-contiguous: the
+                # wait since the previous tick is part of the chunk
+                tracing.record(st.req.trace, "serve.prefill",
+                               st.seq.t_cursor, now,
+                               {"chunk_tokens": int(takes[j])})
+                st.seq.t_cursor = now
             if st.seq.length >= st.req.tokens.size:
                 # final chunk: TTFT ends here — sample the first token
                 # through the same path as a direct admission
@@ -431,6 +450,12 @@ class _SpecMixin:
         for seq in active:
             seq.length += 1
             self._lengths[seq.slot] = seq.length
+            if seq.req.trace is not None:
+                # before _emit — a finishing sequence settles (and
+                # finalizes its trace) inside _emit
+                tracing.record(seq.req.trace, "serve.decode_tick",
+                               seq.t_cursor, now)
+                seq.t_cursor = now
             self._emit(seq, logits[seq.slot], now)
             if (self._seqs[seq.slot] is seq
                     and seq.req.deadline is not None
@@ -451,8 +476,10 @@ class _SpecMixin:
             self.active_high_water = max(self.active_high_water,
                                          len(active))
         telemetry.histogram("serve_decode_active").observe(len(active))
+        t_d0 = time.monotonic()
         drafts = self.draft.propose(tokens, k)     # (slots, k)
         cand = np.concatenate([tokens[:, None], drafts], axis=1)
+        t_v0 = time.monotonic()
         logits = self._verify_batch(cand, amask)   # (slots, k+1, V)
         now = time.monotonic()
         proposed = accepted = 0
@@ -463,6 +490,20 @@ class _SpecMixin:
             proposed += k
             accepted += matched
             kept = self._emit_run(seq, toks, rows, now, finish=False)
+            if seq.req.trace is not None:
+                tick = tracing.record(
+                    seq.req.trace, "serve.decode_tick",
+                    seq.t_cursor, now,
+                    {"kind": "spec", "proposed": int(k),
+                     "accepted": int(matched)})
+                seq.t_cursor = now
+                if tick is not None:
+                    # batch-wide draft/verify sub-phases, parented
+                    # under this tick — overlap detail, not summed
+                    tracing.record(seq.req.trace, "serve.draft",
+                                   t_d0, t_v0, None, tick)
+                    tracing.record(seq.req.trace, "serve.verify",
+                                   t_v0, now, None, tick)
             # every kept token except the newest has K/V from the
             # verify scatter; candidates past `kept` are now stale —
             # unreachable through the mask, overwritten later

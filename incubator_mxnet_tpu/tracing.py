@@ -1,0 +1,445 @@
+"""Distributed request/step tracing — the flight recorder.
+
+PR 2's telemetry registry answers *how much* (counters, histograms,
+Chrome ``"C"`` samples); this module answers *where an individual p99
+went*.  It is a Dapper-style span layer (Sigelman et al., 2010):
+every traced request owns a ``trace_id``, every phase a
+``(span_id, parent_id)`` pair with monotonic-clock timestamps, and the
+context rides every hop the stack already owns — router admission →
+length-prefixed RPC framing → replica engine → chunked prefill /
+speculative verify ticks — so ``tools/trace_query.py`` can mine the
+span trees for Mystery-Machine-style critical-path attribution
+(Chow et al., OSDI'14).
+
+Design contract (mirrors ``telemetry.py``):
+
+* **Disabled mode is zero-allocation.**  The module gate is one global
+  (``_REC``); every entry point early-returns ``None`` when it is
+  unset, and no hot-path signature takes ``**kwargs`` (a kwargs call
+  allocates a dict even when the callee ignores it).  Call sites keep
+  the contract by guarding ``if ctx is not None:`` so span bookkeeping
+  never executes when tracing is off.
+* **Tail-based sampling.**  The keep/drop decision happens when a
+  trace *finishes*, so traces that shed, error, or bust their deadline
+  class are always kept (``flag()``), and only the boring rest is
+  down-sampled.  Healthy traces are kept deterministically by hashing
+  the trace id against ``TP_TRACING_SAMPLE`` — a distributed trace's
+  fragments reach the same verdict on every process without a
+  coordination round-trip.
+* **Bounded memory.**  Finished-and-kept traces land in a
+  ``deque(maxlen=TP_TRACING_RING)`` flight-recorder ring; live traces
+  are capped too (oldest evicted) so leaked contexts cannot grow
+  without bound.
+* **Two exposition formats**, like telemetry: a queryable JSONL (one
+  trace per line, consumed by ``tools/trace_query.py``) and Chrome
+  async ``"b"``/``"e"`` events keyed by trace id merged into the
+  existing profiler trace next to the ``"C"`` counters.
+
+Wire format: ``SpanContext.to_wire()`` is a plain ``(trace_id,
+span_id)`` int tuple — it pickles inside the existing ps.py framing
+with no schema change.  ``from_wire`` on the receiving side either
+joins the local trace (in-process replica) or *adopts* the id as a
+remote-owned fragment that ``finish_remote`` finalizes after the
+reply is sent.
+
+Env knobs (``docs/env_var.md``): ``TP_TRACING=1`` enables at import;
+``TP_TRACING_SAMPLE`` (default 0.05) keep-fraction for unflagged
+traces; ``TP_TRACING_RING`` (default 512) ring capacity;
+``TP_TRACING_PATH`` (default ``traces.jsonl``) flush target.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import profiler
+from .base import get_env
+
+__all__ = ["SpanContext", "enabled", "enable", "disable", "start_trace",
+           "end_trace", "record", "flag", "from_wire", "finish_remote",
+           "set_train_context", "train_context", "flush", "drain",
+           "stats"]
+
+# deterministic hash → [0, 1): Knuth multiplicative on the low 32 bits,
+# so every process holding a fragment of the same trace samples it the
+# same way
+_HASH_MUL = 2654435761
+_HASH_MOD = 1 << 32
+
+
+def _sample_key(trace_id: int) -> float:
+    return ((trace_id * _HASH_MUL) % _HASH_MOD) / _HASH_MOD
+
+
+class SpanContext:
+    """Propagated handle: the trace plus the span new children parent
+    to.  Immutable by convention; cheap enough to mint per hop."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: int, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def to_wire(self) -> Tuple[int, int]:
+        """Plain-tuple form that pickles inside the RPC framing."""
+        return (self.trace_id, self.span_id)
+
+    def __repr__(self):
+        return "SpanContext(%x, %d)" % (self.trace_id, self.span_id)
+
+
+class _Trace:
+    __slots__ = ("trace_id", "name", "t0", "t1", "root_id", "spans",
+                 "flags", "remote", "attrs")
+
+    def __init__(self, trace_id, name, t0, root_id, remote, attrs):
+        self.trace_id = trace_id
+        self.name = name
+        self.t0 = t0
+        self.t1 = None
+        self.root_id = root_id
+        # (span_id, parent_id, name, t0, t1, attrs) tuples
+        self.spans: List[tuple] = []
+        self.flags: List[str] = []
+        self.remote = remote
+        self.attrs = attrs
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"trace_id": "%016x" % self.trace_id, "name": self.name,
+             "t0": self.t0, "t1": self.t1, "flags": list(self.flags),
+             "remote": self.remote,
+             "spans": [{"span_id": s[0], "parent_id": s[1],
+                        "name": s[2], "t0": s[3], "t1": s[4],
+                        "attrs": s[5]} for s in self.spans]}
+        if self.attrs:
+            d["attrs"] = self.attrs
+        return d
+
+
+class _Recorder:
+    """The flight recorder: live traces + the kept-trace ring."""
+
+    # live-trace cap — leaked contexts (a caller that never reaches
+    # end_trace) must not grow without bound; oldest-first eviction
+    # matches the ring's flight-recorder semantics
+    MAX_ACTIVE = 4096
+
+    def __init__(self, path: str, sample: float, ring: int):
+        self.path = path
+        self.sample = float(sample)
+        self._lock = threading.Lock()
+        self._active: Dict[int, _Trace] = {}
+        self.ring: deque = deque(maxlen=max(1, int(ring)))
+        self._next_id = 1
+        # seeded off the monotonic epoch so concurrent processes mint
+        # disjoint trace ids without coordination
+        self._id_base = (int(time.monotonic_ns()) * _HASH_MUL) \
+            & ((1 << 62) - 1)
+        # one-time clock bridge: spans carry time.monotonic() (the
+        # repo-wide deadline clock); the Chrome trace runs on the
+        # profiler's perf_counter epoch
+        self._mono_off = time.perf_counter() - time.monotonic()
+        self.kept = 0
+        self.dropped = 0
+
+    # ------------------------------------------------------------- ids
+    def _new_id(self) -> int:
+        # caller holds self._lock
+        i = self._next_id
+        self._next_id += 1
+        return i
+
+    # ---------------------------------------------------------- traces
+    def start(self, name: str, attrs) -> SpanContext:
+        t0 = time.monotonic()
+        with self._lock:
+            sid = self._new_id()
+            tid = (self._id_base + sid) & ((1 << 62) - 1)
+            self._evict_locked()
+            self._active[tid] = _Trace(tid, name, t0, sid, False, attrs)
+        return SpanContext(tid, sid)
+
+    def adopt(self, tid: int, sid: int) -> SpanContext:
+        """Register a remote-minted trace id as a local fragment."""
+        with self._lock:
+            if tid not in self._active:
+                self._evict_locked()
+                self._active[tid] = _Trace(
+                    tid, "remote", time.monotonic(), sid, True, None)
+        return SpanContext(tid, sid)
+
+    def _evict_locked(self):
+        while len(self._active) >= self.MAX_ACTIVE:
+            old = next(iter(self._active))
+            del self._active[old]
+            self.dropped += 1
+
+    def record(self, ctx, name, t0, t1, attrs, parent) -> Optional[int]:
+        with self._lock:
+            tr = self._active.get(ctx.trace_id)
+            if tr is None:
+                return None  # trace already finalized — late span
+            sid = self._new_id()
+            tr.spans.append((sid, parent if parent is not None
+                             else ctx.span_id, name, t0, t1, attrs))
+        return sid
+
+    def flag(self, ctx, reason: str) -> None:
+        with self._lock:
+            tr = self._active.get(ctx.trace_id)
+            if tr is not None and reason not in tr.flags:
+                tr.flags.append(reason)
+
+    def finish(self, ctx, remote_only: bool) -> None:
+        with self._lock:
+            tr = self._active.get(ctx.trace_id)
+            if tr is None or (remote_only and not tr.remote):
+                return
+            del self._active[ctx.trace_id]
+            tr.t1 = time.monotonic()
+            # tail decision: flagged traces always survive; the rest by
+            # the deterministic per-trace hash
+            if tr.flags or _sample_key(tr.trace_id) < self.sample:
+                self.ring.append(tr)
+                self.kept += 1
+            else:
+                self.dropped += 1
+
+    # ------------------------------------------------------------ drain
+    def drain(self) -> List[Dict[str, Any]]:
+        out = []
+        with self._lock:
+            while self.ring:
+                out.append(self.ring.popleft())
+        return [t.to_dict() for t in out]
+
+    def flush(self, path: Optional[str] = None) -> Optional[str]:
+        with self._lock:
+            traces = list(self.ring)
+            self.ring.clear()
+        if not traces:
+            return None
+        path = path or self.path
+        with open(path, "a") as f:
+            for tr in traces:
+                f.write(json.dumps(tr.to_dict()) + "\n")
+        # mirror into the Chrome trace as async events keyed by the
+        # trace id — each trace renders as one async track next to the
+        # telemetry "C" counters
+        off = self._mono_off
+        for tr in traces:
+            aid = "%016x" % tr.trace_id
+            profiler.record_async(tr.name, aid, tr.t0 + off,
+                                  (tr.t1 if tr.t1 is not None
+                                   else tr.t0) + off,
+                                  cat="trace",
+                                  args={"flags": tr.flags,
+                                        "span_id": tr.root_id})
+            for sid, pid, name, t0, t1, attrs in tr.spans:
+                args = {"span_id": sid, "parent_id": pid}
+                if attrs:
+                    args.update(attrs)
+                profiler.record_async(name, aid, t0 + off, t1 + off,
+                                      cat="trace", args=args)
+        return path
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"active": len(self._active), "ring": len(self.ring),
+                    "kept": self.kept, "dropped": self.dropped,
+                    "sample": self.sample,
+                    "ring_capacity": self.ring.maxlen}
+
+
+# ---------------------------------------------------------------------------
+# module state — one process-wide recorder, exactly like telemetry._REG
+# ---------------------------------------------------------------------------
+
+_REC: Optional[_Recorder] = None
+_state_lock = threading.Lock()
+_atexit_registered = False
+# the train loop's current step context (fit is single-threaded; the
+# helpers that record against it — fences, PS RPCs, checkpoint writes —
+# read it without coordination)
+_train_ctx: Optional[SpanContext] = None
+
+
+def enabled() -> bool:
+    return _REC is not None
+
+
+def enable(path: Optional[str] = None, sample: Optional[float] = None,
+           ring: Optional[int] = None) -> None:
+    """Turn the recorder on (idempotent; reconfigures if repeated)."""
+    global _REC, _atexit_registered
+    with _state_lock:
+        _REC = _Recorder(
+            path if path is not None
+            else get_env("TRACING_PATH", "traces.jsonl"),
+            sample if sample is not None
+            else get_env("TRACING_SAMPLE", 0.05, float),
+            ring if ring is not None
+            else get_env("TRACING_RING", 512, int))
+        if not _atexit_registered:
+            atexit.register(_at_exit)
+            _atexit_registered = True
+
+
+def disable() -> None:
+    """Flush and turn the recorder off (tests; symmetric with
+    ``telemetry.disable``)."""
+    global _REC, _train_ctx
+    with _state_lock:
+        rec = _REC
+        _REC = None
+        _train_ctx = None
+    if rec is not None:
+        try:
+            rec.flush()
+        except OSError:
+            pass
+
+
+def _at_exit() -> None:
+    rec = _REC
+    if rec is not None:
+        try:
+            rec.flush()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# hot-path entry points — every one early-returns on the disabled gate
+# and takes no **kwargs (zero allocations when tracing is off)
+# ---------------------------------------------------------------------------
+
+
+def start_trace(name: str, attrs: Optional[Dict[str, Any]] = None
+                ) -> Optional[SpanContext]:
+    """Open a root span; returns the context to propagate, or ``None``
+    when tracing is disabled (call sites guard on that)."""
+    rec = _REC
+    if rec is None:
+        return None
+    return rec.start(name, attrs)
+
+
+def end_trace(ctx: Optional[SpanContext]) -> None:
+    """Close a locally-owned trace and run the tail keep/drop decision."""
+    rec = _REC
+    if rec is None or ctx is None:
+        return
+    rec.finish(ctx, remote_only=False)
+
+
+def record(ctx: Optional[SpanContext], name: str, t0: float, t1: float,
+           attrs: Optional[Dict[str, Any]] = None,
+           parent: Optional[int] = None) -> Optional[int]:
+    """Append one completed span ``[t0, t1]`` (monotonic seconds) under
+    ``ctx`` — parented to the context span unless ``parent`` names
+    another span id.  Returns the new span id (for sub-span parenting),
+    or ``None`` if the trace is gone/disabled."""
+    rec = _REC
+    if rec is None or ctx is None:
+        return None
+    return rec.record(ctx, name, t0, t1, attrs, parent)
+
+
+def flag(ctx: Optional[SpanContext], reason: str) -> None:
+    """Mark the trace as must-keep (shed / error / deadline bust)."""
+    rec = _REC
+    if rec is None or ctx is None:
+        return
+    rec.flag(ctx, reason)
+
+
+def from_wire(wire) -> Optional[SpanContext]:
+    """Re-hydrate a propagated ``(trace_id, span_id)`` tuple.  Joins
+    the local trace when the id is known (in-process replica); adopts
+    it as a remote-owned fragment otherwise."""
+    rec = _REC
+    if rec is None or wire is None:
+        return None
+    if isinstance(wire, SpanContext):
+        return rec.adopt(wire.trace_id, wire.span_id)
+    try:
+        tid, sid = wire
+    except (TypeError, ValueError):
+        return None
+    return rec.adopt(int(tid), int(sid))
+
+
+def finish_remote(ctx_or_wire) -> None:
+    """Finalize a trace fragment this process *adopted* from the wire.
+    No-op for locally-rooted traces (their owner's ``end_trace`` runs
+    the tail decision) — safe to call unconditionally after replying."""
+    rec = _REC
+    if rec is None or ctx_or_wire is None:
+        return
+    ctx = ctx_or_wire
+    if not isinstance(ctx, SpanContext):
+        # parse the tuple directly — going through from_wire would
+        # re-ADOPT a trace the owner already finalized, resurrecting
+        # it as an empty fragment
+        try:
+            tid, sid = ctx_or_wire
+        except (TypeError, ValueError):
+            return
+        ctx = SpanContext(int(tid), int(sid))
+    rec.finish(ctx, remote_only=True)
+
+
+def set_train_context(ctx: Optional[SpanContext]) -> None:
+    """Publish the current train step's context for the helpers that
+    can't see the loop (fences, PS RPCs, async checkpoint writes)."""
+    global _train_ctx
+    _train_ctx = ctx
+
+
+def train_context() -> Optional[SpanContext]:
+    if _REC is None:
+        return None
+    return _train_ctx
+
+
+# ---------------------------------------------------------------------------
+# exposition
+# ---------------------------------------------------------------------------
+
+
+def flush(path: Optional[str] = None) -> Optional[str]:
+    """Append kept traces as JSONL + Chrome async events; returns the
+    path written (``None`` when there was nothing to write)."""
+    rec = _REC
+    if rec is None:
+        return None
+    return rec.flush(path)
+
+
+def drain() -> List[Dict[str, Any]]:
+    """Pop kept traces as dicts (test/CLI hook; bypasses the file)."""
+    rec = _REC
+    if rec is None:
+        return []
+    return rec.drain()
+
+
+def stats() -> Dict[str, Any]:
+    rec = _REC
+    if rec is None:
+        return {"enabled": False}
+    d = rec.stats()
+    d["enabled"] = True
+    return d
+
+
+# -- env gate (mirrors telemetry's import-time switch) -----------------------
+
+if get_env("TRACING", False, bool):
+    enable()

@@ -47,7 +47,12 @@ Checks, in order:
    collectives bit-identical to the monolithic path on the fused AND
    pipeline steps, ZeRO on/off, grad-accum >= 1
    (``tests/test_grad_buckets.py``; ``TP_CHECK_COMM=0`` skips);
-13. **static-analysis** — the ``tools/lint.py`` suite (graph verifier
+13. **tracing** — the distributed-tracing subset: disabled-mode
+   zero-allocation, tail sampling keeping every flagged trace, the
+   wire round-trip, and the fleet span tree whose phases sum to the
+   observed request latency (``tests/test_tracing.py``;
+   ``TP_CHECK_TRACING=0`` skips);
+14. **static-analysis** — the ``tools/lint.py`` suite (graph verifier
    over the model zoo, tracing-hazard lint, lock-order checker,
    lockset race detector, env-knob drift incl. documented defaults;
    docs/static_analysis.md): zero unsuppressed findings (needs jax —
@@ -454,6 +459,35 @@ def check_comm(problems):
                         + "\n  ".join(tail))
 
 
+def check_tracing(problems):
+    """Distributed-tracing gate (docs/tracing.md): the flight
+    recorder's disabled mode allocates nothing, tail sampling keeps
+    every shed/error/deadline trace, the span context survives the
+    TCP wire round-trip, and a traced fleet request yields one
+    connected span tree whose primary phases sum to the observed
+    latency (``tests/test_tracing.py``, slow fleet test included;
+    needs jax — skip with ``TP_CHECK_TRACING=0``)."""
+    if os.environ.get("TP_CHECK_TRACING", "1") == "0":
+        return
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q",
+             "-p", "no:cacheprovider", "-p", "no:randomly",
+             "tests/test_tracing.py"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        problems.append("tracing: gate run did not finish: %s" % e)
+        return
+    if proc.returncode != 0:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-12:]
+        problems.append("tracing: distributed-tracing gate failed:\n  "
+                        + "\n  ".join(tail))
+
+
 def check_static_analysis(problems):
     """Static-analysis gate (docs/static_analysis.md): run the full
     ``tools/lint.py`` suite — graph verifier over the model zoo,
@@ -495,6 +529,7 @@ def main():
     check_quant(problems)
     check_resilience(problems)
     check_comm(problems)
+    check_tracing(problems)
     check_static_analysis(problems)
     for p in problems:
         print(p)

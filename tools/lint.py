@@ -45,6 +45,7 @@ LOCK_MODULES = [
     "incubator_mxnet_tpu/resilience/faults.py",
     "incubator_mxnet_tpu/ps.py",
     "incubator_mxnet_tpu/telemetry.py",
+    "incubator_mxnet_tpu/tracing.py",
     "incubator_mxnet_tpu/overlap.py",
     "incubator_mxnet_tpu/recordio.py",
     "incubator_mxnet_tpu/engine.py",

@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Query the tracing flight-recorder JSONL — p99 attribution.
+
+Works on the files ``tracing.flush()`` appends (one trace per line,
+possibly from several processes — lines sharing a ``trace_id`` are
+merged into one span tree before analysis).  Stdlib only.
+
+Usage::
+
+    python tools/trace_query.py traces.jsonl            # full report
+    python tools/trace_query.py traces.jsonl --slow 3   # 3 slowest trees
+    python tools/trace_query.py traces.jsonl --name serve.request
+
+Prints, for the selected root-span name:
+
+* latency quantiles (p50/p90/p99 TTFT and end-to-end),
+* the critical-path breakdown — how much of p50 vs p99 end-to-end
+  latency each *primary* phase accounts for.  Primary phases
+  (``serve.queue``, ``serve.prefill``, ``serve.decode_tick`` on the
+  serve side; ``train.input_wait``, ``train.dispatch``, ``train.fence``
+  on the train side) are contiguous by construction and sum to the
+  root span; everything else (``serve.rpc``, ``serve.page_alloc``,
+  ``serve.draft``, ...) overlaps a primary phase and is reported
+  separately as attribution detail,
+* per-tenant / per-deadline-class SLO attainment over the *recorded*
+  traces (tail sampling keeps every shed/error/deadline trace, so the
+  recorded set over-represents failures by design — the table also
+  shows raw counts so that is visible).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+# phases that partition the root span end-to-end (see tracing.py and
+# the _Seq.t_cursor contract in serving/generate.py); everything else
+# overlaps one of these and must not be double-counted in the sum
+PRIMARY = {
+    "serve.request": ("serve.queue", "serve.prefill", "serve.decode_tick"),
+    "train.step": ("train.input_wait", "train.dispatch", "train.fence"),
+}
+
+
+def load_traces(path):
+    """-> list of merged trace dicts (one per trace_id).
+
+    A distributed trace appears as several JSONL lines — the
+    locally-rooted line plus ``remote`` fragments flushed by replica
+    processes.  Merge their spans; root metadata (name/t0/t1/attrs)
+    comes from the non-remote line, flags from every line.
+    """
+    by_id = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            tr = json.loads(line)
+            tid = tr.get("trace_id")
+            cur = by_id.get(tid)
+            if cur is None:
+                by_id[tid] = tr
+                continue
+            # merge: keep the non-remote line as the canonical root
+            root, frag = (cur, tr) if tr.get("remote") else (tr, cur)
+            root.setdefault("spans", []).extend(frag.get("spans", []))
+            for fl in frag.get("flags", []):
+                if fl not in root.setdefault("flags", []):
+                    root["flags"].append(fl)
+            by_id[tid] = root
+    return list(by_id.values())
+
+
+def _quantile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def analyze(traces, name="serve.request"):
+    """-> per-trace rows + aggregate phase stats for one root name."""
+    primary = PRIMARY.get(name, ())
+    rows = []
+    for tr in traces:
+        if tr.get("name") != name or tr.get("t1") is None:
+            continue
+        e2e = tr["t1"] - tr["t0"]
+        phases = defaultdict(float)
+        ttft = None
+        for s in tr.get("spans", []):
+            phases[s["name"]] += s["t1"] - s["t0"]
+            # TTFT = submit -> end of the first prefill (first tokens
+            # become emittable right after the prompt is absorbed)
+            if s["name"] == "serve.prefill":
+                end = s["t1"]
+                if ttft is None or end < ttft:
+                    ttft = end
+        attrs = tr.get("attrs") or {}
+        accounted = sum(phases[p] for p in primary)
+        rows.append({
+            "trace_id": tr.get("trace_id"),
+            "e2e": e2e,
+            "ttft": (ttft - tr["t0"]) if ttft is not None else None,
+            "phases": dict(phases),
+            "unattributed": max(0.0, e2e - accounted),
+            "flags": tr.get("flags", []),
+            "tenant": attrs.get("tenant"),
+            "klass": attrs.get("class"),
+        })
+    return rows
+
+
+def _fmt_s(v):
+    if v is None:
+        return "-"
+    if v >= 1.0:
+        return "%.3f s" % v
+    return "%.1f ms" % (v * 1e3)
+
+
+def print_report(rows, name, slow=0, out=sys.stdout):
+    if not rows:
+        out.write("no '%s' traces\n" % name)
+        return
+    primary = PRIMARY.get(name, ())
+    e2es = sorted(r["e2e"] for r in rows)
+    ttfts = sorted(r["ttft"] for r in rows if r["ttft"] is not None)
+
+    out.write("%s: %d traces\n" % (name, len(rows)))
+    out.write("\nLatency quantiles\n")
+    out.write("%-8s %12s %12s\n" % ("", "TTFT", "E2E"))
+    for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+        out.write("%-8s %12s %12s\n"
+                  % (label, _fmt_s(_quantile(ttfts, q)),
+                     _fmt_s(_quantile(e2es, q))))
+
+    # critical-path attribution: mean share of each phase inside the
+    # p50-and-below vs the p99-and-above cohorts — "where does the p99
+    # go that the p50 doesn't"
+    all_phases = sorted(set(p for r in rows for p in r["phases"]))
+    p50_cut = _quantile(e2es, 0.5)
+    p99_cut = _quantile(e2es, 0.99)
+    fast = [r for r in rows if r["e2e"] <= p50_cut]
+    slow_rows = [r for r in rows if r["e2e"] >= p99_cut] or [rows[-1]]
+
+    def mean_phase(cohort, ph):
+        return sum(r["phases"].get(ph, 0.0) for r in cohort) / len(cohort)
+
+    out.write("\nCritical-path breakdown (mean seconds per request)\n")
+    out.write("%-24s %12s %12s %8s\n"
+              % ("phase", "p50 cohort", "p99 cohort", ""))
+    for ph in all_phases:
+        tag = "" if ph in primary else "(overlay)"
+        out.write("%-24s %12s %12s %8s\n"
+                  % (ph, _fmt_s(mean_phase(fast, ph)),
+                     _fmt_s(mean_phase(slow_rows, ph)), tag))
+    out.write("%-24s %12s %12s\n"
+              % ("(unattributed)",
+                 _fmt_s(sum(r["unattributed"] for r in fast) / len(fast)),
+                 _fmt_s(sum(r["unattributed"] for r in slow_rows)
+                        / len(slow_rows))))
+
+    # SLO attainment per tenant/class over the recorded set.  Tail
+    # sampling keeps all flagged traces, so failures are
+    # over-represented here by design — raw counts make that visible.
+    cells = defaultdict(lambda: [0, 0])  # (tenant, class) -> [n, bad]
+    for r in rows:
+        c = cells[(r["tenant"] or "-", r["klass"] or "-")]
+        c[0] += 1
+        if r["flags"]:
+            c[1] += 1
+    out.write("\nSLO attainment (recorded traces; tail sampling keeps"
+              " all failures)\n")
+    out.write("%-16s %-12s %8s %8s %12s\n"
+              % ("tenant", "class", "n", "flagged", "attainment"))
+    for (tenant, klass), (n, bad) in sorted(cells.items()):
+        out.write("%-16s %-12s %8d %8d %11.1f%%\n"
+                  % (tenant, klass, n, bad, 100.0 * (n - bad) / n))
+
+    if slow > 0:
+        out.write("\nSlowest traces\n")
+        for r in sorted(rows, key=lambda r: -r["e2e"])[:slow]:
+            out.write("%s  e2e=%s ttft=%s flags=%s\n"
+                      % (r["trace_id"], _fmt_s(r["e2e"]),
+                         _fmt_s(r["ttft"]), r["flags"] or "-"))
+            for ph in sorted(r["phases"], key=lambda p: -r["phases"][p]):
+                tag = "" if ph in primary else "  (overlay)"
+                out.write("    %-24s %12s%s\n"
+                          % (ph, _fmt_s(r["phases"][ph]), tag))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("traces", help="JSONL file written by tracing.flush()")
+    ap.add_argument("--name", default="serve.request",
+                    help="root span name (default serve.request; use"
+                         " train.step for the train side)")
+    ap.add_argument("--slow", type=int, default=0,
+                    help="also print the N slowest span trees")
+    args = ap.parse_args(argv)
+    traces = load_traces(args.traces)
+    rows = analyze(traces, name=args.name)
+    print_report(rows, args.name, slow=args.slow)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,16 +2,21 @@
 """Summarize a Chrome trace-event ``profile.json``.
 
 Works on the files ``profiler.dump_profile()`` writes: paired ``B``/``E``
-span events, ``X`` complete events, ``C`` counter events (telemetry), and
-``M`` thread_name metadata.  Stdlib only.
+span events, ``X`` complete events, ``C`` counter events (telemetry),
+``M`` thread_name metadata, and async ``b``/``e`` pairs (the tracing
+flight recorder's per-request span trees, keyed by trace id).  Stdlib
+only.
 
 Usage::
 
     python tools/trace_summary.py profile.json [--top 15]
 
 Prints the top-N ops by total and self time (self = total minus time
-spent in nested spans on the same thread), per-thread span counts, and
-the last value + sample count of every counter series.
+spent in nested spans on the same thread), per-thread span counts, the
+last value + sample count of every counter series, and — when tracing
+events are present — a per-phase duration table over the async spans
+plus a span-tree sanity check (spans whose ``parent_id`` is missing
+from their trace are reported as orphans).
 """
 from __future__ import annotations
 
@@ -85,6 +90,44 @@ def summarize(events):
     return op_stats, thread_counts, counters, thread_names
 
 
+def summarize_async(events):
+    """-> (span_stats, orphans) over the tracing ``b``/``e`` pairs.
+
+    span_stats: name -> {"count", "total_us"}; orphans: list of
+    (trace_id, span_id, parent_id) whose parent never appears in the
+    same trace — a propagation bug if non-empty.
+    """
+    span_stats = defaultdict(lambda: {"count": 0, "total_us": 0.0})
+    open_t = {}
+    ids_by_trace = defaultdict(set)
+    edges = []
+    for e in events:
+        ph = e.get("ph")
+        if ph not in ("b", "e"):
+            continue
+        args = e.get("args") or {}
+        # b/e pairs share (trace id, name, span_id) — span_id keeps
+        # repeated phases (decode ticks) from cross-pairing
+        key = (e.get("id"), e.get("name"), args.get("span_id"))
+        if ph == "b":
+            open_t[key] = e.get("ts", 0.0)
+            if args.get("span_id") is not None:
+                ids_by_trace[e.get("id")].add(args["span_id"])
+            if args.get("parent_id") is not None:
+                edges.append((e.get("id"), args.get("span_id"),
+                              args["parent_id"]))
+        else:
+            t0 = open_t.pop(key, None)
+            if t0 is None:
+                continue  # unmatched e: drop rather than crash
+            st = span_stats[e.get("name", "?")]
+            st["count"] += 1
+            st["total_us"] += max(0.0, e.get("ts", 0.0) - t0)
+    orphans = [(tid, sid, pid) for tid, sid, pid in edges
+               if pid not in ids_by_trace.get(tid, ())]
+    return span_stats, orphans
+
+
 def _fmt_us(us):
     if us >= 1e6:
         return "%.3f s" % (us / 1e6)
@@ -126,6 +169,27 @@ def print_report(op_stats, thread_counts, counters, thread_names,
                                             c["last"]))
 
 
+def print_async_report(span_stats, orphans, out=sys.stdout):
+    if not span_stats:
+        return
+    out.write("\nTracing phases (async spans)\n")
+    out.write("%-32s %8s %14s %14s\n"
+              % ("phase", "count", "total", "mean"))
+    rows = sorted(span_stats.items(), key=lambda kv: -kv[1]["total_us"])
+    for name, st in rows:
+        out.write("%-32s %8d %14s %14s\n"
+                  % (name[:32], st["count"], _fmt_us(st["total_us"]),
+                     _fmt_us(st["total_us"] / st["count"])))
+    if orphans:
+        out.write("\nWARNING: %d orphan spans (parent missing from"
+                  " trace — propagation bug?)\n" % len(orphans))
+        for tid, sid, pid in orphans[:10]:
+            out.write("  trace %s span %s -> missing parent %s\n"
+                      % (tid, sid, pid))
+    else:
+        out.write("span-tree check: all parents resolved\n")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", help="Chrome trace-event JSON file")
@@ -134,6 +198,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
     events = load_events(args.trace)
     print_report(*summarize(events), top=args.top)
+    print_async_report(*summarize_async(events))
     return 0
 
 
